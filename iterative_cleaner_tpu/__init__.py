@@ -1,0 +1,26 @@
+"""iterative_cleaner_tpu — a TPU-native iterative "surgical" RFI cleaner framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the reference
+``iterative_cleaner`` (bwmeyers/iterative_cleaner, a single-file numpy/psrchive
+script): iterative template subtraction + robust outlier statistics over a
+pulsar-archive data cube, with the whole per-iteration pipeline fused into one
+jitted TPU kernel and multi-archive batches sharded over a device mesh.
+
+Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
+
+  CLI / driver        iterative_cleaner_tpu.cli, .driver      (host)
+  model               iterative_cleaner_tpu.models.surgical   (flagship cleaner)
+  core loop           iterative_cleaner_tpu.core.cleaner      (backend-agnostic)
+  backends            .backends.numpy_backend (oracle)        (executable spec)
+                      .backends.jax_backend   (TPU kernel)    (jit / while_loop)
+  ops                 .ops.*                  (stats, template fit, preprocess)
+  parallel            .parallel.*             (mesh, shard_map, batch pmap)
+  io                  .io.*                   (NPZ canonical, psrchive optional)
+"""
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.io.base import Archive
+
+__version__ = "0.1.0"
+
+__all__ = ["CleanConfig", "Archive", "__version__"]

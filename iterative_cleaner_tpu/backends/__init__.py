@@ -1,0 +1,3 @@
+from iterative_cleaner_tpu.backends.base import CleanerBackend, make_backend
+
+__all__ = ["CleanerBackend", "make_backend"]

@@ -1,0 +1,45 @@
+"""Backend protocol: one cleaning iteration as a pure array function.
+
+A backend owns the static kernel inputs (the preprocessed cube ``D`` and the
+frozen original weights ``w0`` — SURVEY.md §8.L11) and exposes ``step``:
+given the previous iteration's weights (which shape the template and nothing
+else — SURVEY.md §3.2), produce the outlier test results and the next weight
+matrix.  The convergence loop above it is backend-agnostic
+(:mod:`..core.cleaner`).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+import numpy as np
+
+from iterative_cleaner_tpu.config import CleanConfig
+
+
+class CleanerBackend(Protocol):
+    def step(self, w_prev: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """w_prev (nsub, nchan) → (test_results, new_weights).
+
+        ``new_weights = where(test_results >= 1, 0, w0)`` — the semantics of
+        the reference's ``set_weights_archive`` applied to a fresh
+        original-weights clone (iterative_cleaner.py:123-124, 299-304); NaN
+        test results never flag (SURVEY.md §8.L3).
+        """
+        ...
+
+    def residual(self) -> np.ndarray | None:
+        """The last step's unweighted residual ``amp*template - D`` in the
+        dedispersed frame (reference sign convention, iterative_cleaner.py:276),
+        or None if no step has run."""
+        ...
+
+
+def make_backend(D: np.ndarray, w0: np.ndarray, cfg: CleanConfig) -> CleanerBackend:
+    if cfg.backend == "numpy":
+        from iterative_cleaner_tpu.backends.numpy_backend import NumpyCleaner
+
+        return NumpyCleaner(D, w0, cfg)
+    from iterative_cleaner_tpu.backends.jax_backend import JaxCleaner
+
+    return JaxCleaner(D, w0, cfg)

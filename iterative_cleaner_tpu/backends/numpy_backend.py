@@ -1,0 +1,139 @@
+"""The numpy oracle backend — the executable specification.
+
+Reproduces the reference's L2/L3 semantics (SURVEY.md §1) on the preprocessed
+cube, *including* every numpy.ma landmine catalogued in SURVEY.md §8 — this
+path is what the JAX kernel is tested against (flag-mask IoU == 1.0).
+
+Faithfulness notes (each verified empirically on numpy 2.0.2, see
+tests/test_landmines.py):
+
+- The template amplitude fit is the closed form ``amp = <t,p>/<t,t>`` — the
+  reference's per-profile ``scipy.optimize.leastsq`` solves the same linear
+  1-parameter problem (equal to ~1e-9 relative, SURVEY.md §8.L7).  Both
+  backends use the closed form; a degenerate template (<t,t> == 0) yields
+  amp = 1, matching leastsq returning its initial guess.
+- The robust scalers keep the reference's per-row/per-column ``numpy.ma``
+  evaluation order, so masked-division and mask-drop behaviors (§8.L2-L4) come
+  from numpy.ma itself rather than a re-implementation.
+- The FFT diagnostic operates on raw ``._data`` (mask-blind, §8.L1).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from iterative_cleaner_tpu.config import CleanConfig, pulse_region_active
+
+
+def fit_template(
+    D: np.ndarray, template: np.ndarray, pulse_region: tuple[float, float, float]
+) -> tuple[np.ndarray, np.ndarray]:
+    """Closed-form per-profile template fit + subtraction.
+
+    Replaces the reference's nsub*nchan Python→MINPACK round-trips
+    (iterative_cleaner.py:258-287) with two einsums.  Residual sign is
+    model − data, as in the reference (:276).
+    """
+    t = np.asarray(template, dtype=np.float32)
+    tt = np.einsum("b,b->", t, t, dtype=np.float32)
+    if tt == np.float32(0.0) or not np.isfinite(tt):
+        # leastsq cannot improve a flat objective: it returns the initial
+        # amp = 1.0 (SURVEY.md §8.L7 degenerate case).
+        amp = np.ones(D.shape[:2], dtype=np.float32)
+    else:
+        amp = np.einsum("scb,b->sc", D, t, dtype=np.float32) / tt
+    resid = amp[..., None] * t - D
+    if pulse_region_active(pulse_region):
+        # Reference reads [scale, start, end] despite its help text
+        # (iterative_cleaner.py:279-282; SURVEY.md §8.L5).
+        scale, start, end = pulse_region
+        resid[..., int(start) : int(end)] *= np.float32(scale)
+    return amp, resid
+
+
+def build_template(D: np.ndarray, weights: np.ndarray) -> np.ndarray:
+    """Weighted scrunch over (subint, channel) → template profile.
+
+    PSRCHIVE's fscrunch+tscrunch collapse is a weights-weighted combination;
+    the overall template scale (including the reference's ×10000 at :93)
+    cancels out of amp·t (SURVEY.md §8.L7), so the unnormalised weighted sum
+    is used.
+    """
+    return np.einsum("sc,scb->b", weights.astype(np.float32), D, dtype=np.float32)
+
+
+def robust_scale(arr2d, axis: int):
+    """(x − median) / MAD along ``axis``, per the reference's scalers.
+
+    axis=0: scale each channel across subints (channel_scaler,
+    iterative_cleaner.py:228-240); axis=1: scale each subint across channels
+    (subint_scaler, :243-255).  The per-line numpy.ma evaluation order is kept
+    so MAD==0 / all-masked semantics are inherited from numpy.ma (SURVEY.md
+    §8.L4), including the MAD convention without the 1.4826 consistency
+    factor.
+    """
+    out = np.empty_like(arr2d)
+    for i in range(arr2d.shape[1 - axis]):
+        sl = (slice(None), i) if axis == 0 else (i, slice(None))
+        with np.errstate(invalid="ignore", divide="ignore"):
+            vec = arr2d[sl]
+            dev = vec - np.ma.median(vec)
+            out[sl] = dev / np.ma.median(np.abs(dev))
+    return out
+
+
+def comprehensive_stats(data_ma: np.ma.MaskedArray, cfg: CleanConfig) -> np.ndarray:
+    """Four robust diagnostics → per-profile outlier score (reference
+    iterative_cleaner.py:180-225).
+
+    The returned array is plain (masks are dropped at the max step, §8.L2);
+    fully-masked profiles come out NaN and are never flagged (§8.L3).
+    """
+    centred = data_ma - np.expand_dims(data_ma.mean(axis=2), axis=2)
+    diagnostics = [
+        np.ma.std(data_ma, axis=2),
+        np.ma.mean(data_ma, axis=2),
+        np.ma.ptp(data_ma, axis=2),
+        # Mask-blind by construction: np.fft sees raw ._data (§8.L1).
+        np.max(np.abs(np.fft.rfft(centred, axis=2)), axis=2),
+    ]
+    scaled = []
+    for diag in diagnostics:
+        per_chan = np.abs(robust_scale(diag, axis=0)) / cfg.chanthresh
+        per_subint = np.abs(robust_scale(diag, axis=1)) / cfg.subintthresh
+        # np.max over the pair coerces to raw data — the mask-drop (§8.L2).
+        scaled.append(np.max((per_chan, per_subint), axis=0))
+    return np.median(scaled, axis=0)
+
+
+class NumpyCleaner:
+    """Oracle backend over the preprocessed cube (D, w0)."""
+
+    def __init__(self, D: np.ndarray, w0: np.ndarray, cfg: CleanConfig) -> None:
+        self.D = np.ascontiguousarray(D, dtype=np.float32)
+        self.w0 = np.asarray(w0, dtype=np.float32)
+        self.cfg = cfg
+        # 3-D mask from the frozen original weights, as the reference builds
+        # it every iteration (iterative_cleaner.py:114-116).
+        nbin = D.shape[-1]
+        self._mask3d = np.repeat(
+            np.expand_dims(~self.w0.astype(bool), 2), nbin, axis=2
+        )
+        self._residual: np.ndarray | None = None
+
+    def step(self, w_prev: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        template = build_template(self.D, np.asarray(w_prev, np.float32))
+        _amp, resid = fit_template(self.D, template, self.cfg.pulse_region)
+        self._residual = resid
+        # Stats always see the ORIGINAL weighting (§8.L11): weights scale the
+        # data (raw values, not booleans — iterative_cleaner.py:290-296) and
+        # define the mask.
+        weighted = resid * self.w0[..., None]
+        data_ma = np.ma.masked_array(weighted, mask=self._mask3d)
+        test_results = comprehensive_stats(data_ma, self.cfg)
+        new_w = self.w0.copy()
+        new_w[test_results >= 1] = 0.0  # NaN >= 1 is False: never flags (§8.L3)
+        return test_results, new_w
+
+    def residual(self) -> np.ndarray | None:
+        return self._residual

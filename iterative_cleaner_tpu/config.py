@@ -1,0 +1,93 @@
+"""Cleaning configuration.
+
+Field-for-field superset of the reference CLI (reference
+``iterative_cleaner.py:15-41``): every flag of the original argparse interface
+is represented, plus the TPU-framework extensions (``backend``, ``fused``,
+``dtype``).
+
+Note on ``pulse_region``: the reference's help text claims the order is
+``(pulse_start, pulse_end, scaling_factor)`` but the code reads
+``[scale, start, end]`` (reference ``iterative_cleaner.py:279-282``; SURVEY.md
+§8.L5).  We replicate the *code* semantics and document the true order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+
+def pulse_region_active(pulse_region) -> bool:
+    """The reference's disable gate: ``pulse_region != [0, 0, 1]``
+    (iterative_cleaner.py:279).  Shared by config and both backends so the
+    sentinel can never drift."""
+    return tuple(float(v) for v in pulse_region) != (0.0, 0.0, 1.0)
+
+
+@dataclass(frozen=True)
+class CleanConfig:
+    # --- algorithm parameters (reference flags) ---
+    chanthresh: float = 5.0        # -c: sigma threshold along a channel
+    subintthresh: float = 5.0      # -s: sigma threshold along a subint
+    max_iter: int = 5              # -m: maximum number of iterations (must be >= 1)
+    # (scale, start_bin, end_bin); (0, 0, 1) disables. Bins are in the
+    # dedispersed phase frame (reference iterative_cleaner.py:99-100).
+    pulse_region: tuple[float, float, float] = (0.0, 0.0, 1.0)  # -r
+    bad_chan: float = 1.0          # --bad_chan: zap channel if zapped-subint frac > this
+    bad_subint: float = 1.0        # --bad_subint: zap subint if zapped-chan frac > this
+
+    # --- output / driver policy (reference flags) ---
+    output: str = ""               # -o: '' = <orig>_cleaned, 'std' = NAME.FREQ.MJD
+    pscrunch: bool = False         # -p: pscrunch the *output* archive
+    memory: bool = False           # --memory: keep full-pol archive in memory
+    unload_res: bool = False       # -u: write the residual archive
+    print_zap: bool = False        # -z: write the zap plot
+    quiet: bool = False            # -q
+    no_log: bool = False           # -l
+
+    # --- TPU framework extensions ---
+    backend: str = "numpy"         # {'numpy', 'jax'}
+    fused: bool = False            # jax: run the whole loop as one lax.while_loop
+    x64: bool = False              # jax: use float64 intermediates for bit parity
+
+    def __post_init__(self) -> None:
+        if self.max_iter < 1:
+            # The reference crashes with an unbound-variable NameError when
+            # max_iter == 0 (reference iterative_cleaner.py:152; SURVEY.md
+            # §8.L10). We reject it up front instead.
+            raise ValueError(f"max_iter must be >= 1, got {self.max_iter}")
+        if self.backend not in ("numpy", "jax"):
+            raise ValueError(f"unknown backend {self.backend!r}")
+        if len(self.pulse_region) != 3:
+            raise ValueError("pulse_region must have exactly 3 elements")
+        object.__setattr__(self, "pulse_region", tuple(float(v) for v in self.pulse_region))
+
+    @property
+    def pulse_region_active(self) -> bool:
+        return pulse_region_active(self.pulse_region)
+
+    def replace(self, **kw) -> "CleanConfig":
+        return dataclasses.replace(self, **kw)
+
+    def namespace_repr(self, archives: list[str]) -> str:
+        """An argparse.Namespace-style repr, for clean.log parity with the
+        reference log format (reference iterative_cleaner.py:173-176)."""
+        fields = [
+            ("archive", archives),
+            ("chanthresh", self.chanthresh),
+            ("subintthresh", self.subintthresh),
+            ("max_iter", self.max_iter),
+            ("print_zap", self.print_zap),
+            ("unload_res", self.unload_res),
+            ("pscrunch", self.pscrunch),
+            ("quiet", self.quiet),
+            ("no_log", self.no_log),
+            ("pulse_region", list(self.pulse_region)),
+            ("output", self.output),
+            ("memory", self.memory),
+            ("bad_chan", self.bad_chan),
+            ("bad_subint", self.bad_subint),
+            ("backend", self.backend),
+        ]
+        inner = ", ".join(f"{k}={v!r}" for k, v in fields)
+        return f"Namespace({inner})"

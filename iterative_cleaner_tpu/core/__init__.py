@@ -1,0 +1,3 @@
+from iterative_cleaner_tpu.core.cleaner import CleanResult, clean_cube, find_bad_parts
+
+__all__ = ["CleanResult", "clean_cube", "find_bad_parts"]

@@ -1,0 +1,131 @@
+"""Backend-agnostic iterative cleaning loop.
+
+Reproduces the reference's ``clean()`` iteration dynamics and convergence
+bookkeeping (iterative_cleaner.py:64-145; SURVEY.md §3.2):
+
+- weights feed back *only through the template*: each step's stats are
+  computed against the frozen original weights (§8.L11), while ``w_prev``
+  (the previous iteration's zaps) shapes the template;
+- convergence is full-history cycle detection, with the pre-loop weights in
+  the history (§8.L10), so oscillating masks also terminate;
+- ``loops`` records the stopping iteration (it names the residual archive and
+  appears in the log).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.backends.base import make_backend
+
+
+@dataclass
+class IterationInfo:
+    index: int                 # 1-based loop counter (reference's `x`)
+    diff_weights: int          # entries changed vs previous weights
+    rfi_frac: float            # zapped fraction after this iteration
+
+
+@dataclass
+class CleanResult:
+    weights: np.ndarray        # final (nsub, nchan) weights (before bad-parts sweep)
+    test_results: np.ndarray   # last iteration's outlier scores
+    loops: int                 # stopping iteration (reference's `loops`)
+    converged: bool            # True if the mask reached a fixed point / cycle
+    iterations: list[IterationInfo] = field(default_factory=list)
+    history: list[np.ndarray] = field(default_factory=list)
+    residual: np.ndarray | None = None   # unweighted amp*t − D, dedispersed frame
+
+    @property
+    def rfi_frac(self) -> float:
+        return self.iterations[-1].rfi_frac if self.iterations else 0.0
+
+
+ProgressFn = Callable[[IterationInfo], None]
+
+
+def clean_cube(
+    D: np.ndarray,
+    w0: np.ndarray,
+    cfg: CleanConfig,
+    progress: ProgressFn | None = None,
+    want_residual: bool = False,
+) -> CleanResult:
+    """Run the iterative cleaner on a preprocessed cube.
+
+    D: (nsub, nchan, nbin) float32 — pscrunched, baseline-removed,
+    dedispersed.  w0: (nsub, nchan) float32 original weights.
+    """
+    backend = make_backend(D, w0, cfg)
+    w0 = np.asarray(w0, dtype=np.float32)
+
+    history: list[np.ndarray] = [w0.copy()]
+    w_prev = w0
+    infos: list[IterationInfo] = []
+    test_results = None
+    loops = cfg.max_iter
+    converged = False
+
+    for x in range(1, cfg.max_iter + 1):
+        test_results, new_w = backend.step(w_prev)
+        test_results = np.asarray(test_results)
+        new_w = np.asarray(new_w)
+
+        info = IterationInfo(
+            index=x,
+            diff_weights=int(np.sum(new_w != history[-1])),
+            rfi_frac=float((new_w.size - np.count_nonzero(new_w)) / new_w.size),
+        )
+        infos.append(info)
+        if progress is not None:
+            progress(info)
+
+        # Full-history cycle detection, pre-loop weights included (§8.L10).
+        stop = any(np.array_equal(new_w, old) for old in history)
+        history.append(new_w)
+        w_prev = new_w
+        if stop:
+            loops = x
+            converged = True
+            break
+
+    residual = None
+    if want_residual:
+        r = backend.residual()
+        residual = None if r is None else np.asarray(r)
+
+    return CleanResult(
+        weights=history[-1].copy(),
+        test_results=test_results,
+        loops=loops,
+        converged=converged,
+        iterations=infos,
+        history=history,
+        residual=residual,
+    )
+
+
+def find_bad_parts(
+    weights: np.ndarray, cfg: CleanConfig
+) -> tuple[np.ndarray, int, int]:
+    """Whole-subint / whole-channel sweep (reference
+    iterative_cleaner.py:307-334).
+
+    Both passes compute their zapped fraction from the same pre-sweep
+    snapshot (the reference takes ``get_weights()`` once at :310), and both
+    use a *strictly greater* comparison.  Returns (new_weights,
+    n_bad_subints, n_bad_channels).
+    """
+    snapshot = np.asarray(weights)
+    nsub, nchan = snapshot.shape
+    out = snapshot.copy()
+
+    bad_subints = (1.0 - np.count_nonzero(snapshot, axis=1) / float(nchan)) > cfg.bad_subint
+    out[bad_subints, :] = 0.0
+    bad_channels = (1.0 - np.count_nonzero(snapshot, axis=0) / float(nsub)) > cfg.bad_chan
+    out[:, bad_channels] = 0.0
+    return out, int(bad_subints.sum()), int(bad_channels.sum())

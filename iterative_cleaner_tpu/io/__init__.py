@@ -1,0 +1,3 @@
+from iterative_cleaner_tpu.io.base import Archive, ArchiveIO, get_io
+
+__all__ = ["Archive", "ArchiveIO", "get_io"]

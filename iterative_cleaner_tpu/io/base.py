@@ -1,0 +1,103 @@
+"""Archive data model + I/O protocol.
+
+The reference talks to PSRCHIVE (C++ via SWIG) through 22 API methods
+(SURVEY.md §2.3).  This module defines the host-side contract those methods
+imply — an in-memory :class:`Archive` value plus an :class:`ArchiveIO`
+load/save protocol — so the rest of the framework never touches a file format
+directly.  Backends: NPZ (canonical, hermetic; :mod:`..io.npz`) and psrchive
+(optional, real telescope data; :mod:`..io.psrchive_io`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Protocol
+
+import numpy as np
+
+# PSRCHIVE polarization states we distinguish for pscrunch semantics.
+STATE_INTENSITY = "Intensity"   # npol == 1, already total intensity
+STATE_STOKES = "Stokes"         # I,Q,U,V — total intensity is pol 0
+STATE_COHERENCE = "Coherence"   # AA,BB(,CR,CI) — total intensity is AA+BB
+
+
+@dataclass
+class Archive:
+    """In-memory pulsar archive: the 4-D cube + weights + fold metadata.
+
+    Equivalent of the PSRCHIVE Archive object surface the reference uses
+    (``get_data``/``get_weights``/dims/metadata — SURVEY.md §2.3), as a plain
+    value type.
+    """
+
+    data: np.ndarray            # (nsub, npol, nchan, nbin) float32
+    weights: np.ndarray         # (nsub, nchan) float32
+    freqs: np.ndarray           # (nchan,) channel centre frequencies, MHz
+    centre_frequency: float     # MHz
+    dm: float                   # pc cm^-3
+    period: float               # folding period, seconds
+    source: str = "SYNTH"
+    mjd_start: float = 60000.0
+    mjd_end: float = 60000.0
+    state: str = STATE_INTENSITY
+    dedispersed: bool = False   # True once inter-channel delays are removed
+    filename: str = "archive"
+
+    def __post_init__(self) -> None:
+        if self.data.ndim != 4:
+            raise ValueError(f"data must be 4-D (nsub,npol,nchan,nbin), got {self.data.shape}")
+        nsub, _npol, nchan, _nbin = self.data.shape
+        if self.weights.shape != (nsub, nchan):
+            raise ValueError(
+                f"weights shape {self.weights.shape} != (nsub, nchan) = {(nsub, nchan)}")
+        if self.freqs.shape != (nchan,):
+            raise ValueError(f"freqs shape {self.freqs.shape} != ({nchan},)")
+
+    # --- dims (reference get_nsubint/get_nchan/get_nbin) ---
+    @property
+    def nsub(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def npol(self) -> int:
+        return self.data.shape[1]
+
+    @property
+    def nchan(self) -> int:
+        return self.data.shape[2]
+
+    @property
+    def nbin(self) -> int:
+        return self.data.shape[3]
+
+    @property
+    def mjd_mid(self) -> float:
+        # Reference 'std' naming uses the mid-MJD (iterative_cleaner.py:52).
+        return 0.5 * (self.mjd_start + self.mjd_end)
+
+    def copy(self) -> "Archive":
+        return replace(
+            self,
+            data=self.data.copy(),
+            weights=self.weights.copy(),
+            freqs=self.freqs.copy(),
+        )
+
+
+class ArchiveIO(Protocol):
+    """Load/save protocol — the host I/O layer the driver dispatches through."""
+
+    def load(self, path: str) -> Archive: ...
+
+    def save(self, archive: Archive, path: str) -> None: ...
+
+
+def get_io(path: str) -> "ArchiveIO":
+    """Pick an I/O backend from the file extension."""
+    if path.endswith(".npz"):
+        from iterative_cleaner_tpu.io.npz import NpzIO
+
+        return NpzIO()
+    from iterative_cleaner_tpu.io.psrchive_io import PsrchiveIO
+
+    return PsrchiveIO()

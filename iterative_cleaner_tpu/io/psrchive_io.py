@@ -1,0 +1,82 @@
+"""Optional PSRCHIVE archive backend.
+
+Bridges real telescope archives into the framework when the (Python-2-era,
+often unavailable) ``psrchive`` SWIG bindings are importable.  Covers the
+reference's PSRCHIVE API surface (SURVEY.md §2.3): load/unload, data + weight
+extraction, metadata for output naming, and weight write-back on save.
+
+This module is import-safe without psrchive; constructing :class:`PsrchiveIO`
+raises a clear error instead.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from iterative_cleaner_tpu.io.base import (
+    Archive,
+    STATE_COHERENCE,
+    STATE_INTENSITY,
+    STATE_STOKES,
+)
+
+try:  # pragma: no cover - psrchive unavailable in the hermetic environment
+    import psrchive as _psr
+except Exception:  # noqa: BLE001
+    _psr = None
+
+
+def psrchive_available() -> bool:
+    return _psr is not None
+
+
+class PsrchiveIO:  # pragma: no cover - exercised only with real psrchive
+    def __init__(self) -> None:
+        if _psr is None:
+            raise ImportError(
+                "psrchive python bindings are not available; use the .npz "
+                "backend (iterative_cleaner_tpu.io.npz) instead")
+
+    def load(self, path: str) -> Archive:
+        ar = _psr.Archive_load(path)
+        data = np.asarray(ar.get_data(), dtype=np.float32)
+        weights = np.asarray(ar.get_weights(), dtype=np.float32)
+        freqs = np.array(
+            [ar.get_Integration(0).get_centre_frequency(c) for c in range(ar.get_nchan())],
+            dtype=np.float64,
+        )
+        state = str(ar.get_state())
+        if state not in (STATE_INTENSITY, STATE_STOKES, STATE_COHERENCE):
+            state = STATE_STOKES if ar.get_npol() > 1 else STATE_INTENSITY
+        return Archive(
+            data=data,
+            weights=weights,
+            freqs=freqs,
+            centre_frequency=float(ar.get_centre_frequency()),
+            dm=float(ar.get_dispersion_measure()),
+            period=float(ar.get_Integration(0).get_folding_period()),
+            source=str(ar.get_source()),
+            mjd_start=float(ar.start_time().strtempo()),
+            mjd_end=float(ar.end_time().strtempo()),
+            state=state,
+            dedispersed=bool(ar.get_dedispersed()),
+            filename=path,
+        )
+
+    def save(self, archive: Archive, path: str) -> None:
+        # Re-open the source file and write the (possibly updated) weights and
+        # amplitudes back through the PSRCHIVE object model, mirroring the
+        # reference's set_weights_archive + unload flow
+        # (iterative_cleaner.py:299-304, 59).
+        ar = _psr.Archive_load(archive.filename)
+        nsub, npol, nchan, _ = archive.data.shape
+        if ar.get_npol() != npol:
+            ar.pscrunch()
+        for isub in range(nsub):
+            integ = ar.get_Integration(isub)
+            for ichan in range(nchan):
+                integ.set_weight(ichan, float(archive.weights[isub, ichan]))
+                for ipol in range(npol):
+                    prof = ar.get_Profile(isub, ipol, ichan)
+                    prof.get_amps()[:] = archive.data[isub, ipol, ichan]
+        ar.unload(path)

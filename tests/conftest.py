@@ -1,0 +1,36 @@
+"""Test harness setup: force JAX onto a virtual 8-device CPU platform.
+
+Multi-chip hardware is not available in CI; sharding logic is exercised on the
+standard JAX fake-multi-device harness (SURVEY.md §4.4).  Must run before any
+jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.io.synthetic import make_archive, RFISpec
+
+
+@pytest.fixture(scope="session")
+def small_archive():
+    """Config #1 scale: 8 x 64 x 256 with the full RFI menagerie."""
+    return make_archive(nsub=8, nchan=64, nbin=256, seed=42)
+
+
+@pytest.fixture(scope="session")
+def tiny_archive():
+    return make_archive(nsub=4, nchan=16, nbin=64, seed=7, rfi=RFISpec(
+        n_profile_spikes=2, n_dc_profiles=1, n_bad_channels=0, n_bad_subints=0,
+        n_prezapped=1))
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(1234)

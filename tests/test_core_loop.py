@@ -1,0 +1,92 @@
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube, find_bad_parts
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+
+def _cfg(**kw):
+    kw.setdefault("backend", "numpy")
+    return CleanConfig(**kw)
+
+
+def test_max_iter_zero_rejected():
+    with pytest.raises(ValueError):
+        CleanConfig(max_iter=0)
+
+
+def test_clean_flags_injected_rfi(small_archive):
+    D, w0 = preprocess(small_archive)
+    res = clean_cube(D, w0, _cfg(max_iter=5))
+    # RFI was injected -> something must be zapped, but not everything.
+    zapped = (res.weights == 0) & (w0 != 0)
+    assert 0 < zapped.sum() < 0.5 * w0.size
+    assert res.loops <= 5
+    assert len(res.iterations) == (res.loops if res.converged else 5)
+
+
+def test_convergence_is_fixed_point(small_archive):
+    D, w0 = preprocess(small_archive)
+    res = clean_cube(D, w0, _cfg(max_iter=10))
+    if res.converged:
+        # one more step from the final weights reproduces a historical mask
+        from iterative_cleaner_tpu.backends.numpy_backend import NumpyCleaner
+
+        _t, again = NumpyCleaner(D, w0, _cfg()).step(res.weights)
+        assert any(np.array_equal(again, h) for h in res.history)
+
+
+def test_history_starts_with_original_weights(small_archive):
+    D, w0 = preprocess(small_archive)
+    res = clean_cube(D, w0, _cfg(max_iter=2))
+    np.testing.assert_array_equal(res.history[0], w0)
+    assert len(res.history) == len(res.iterations) + 1
+
+
+def test_progress_callback_matches_iterations(small_archive):
+    D, w0 = preprocess(small_archive)
+    seen = []
+    res = clean_cube(D, w0, _cfg(max_iter=3), progress=seen.append)
+    assert [i.index for i in seen] == [i.index for i in res.iterations]
+    assert seen[0].index == 1
+
+
+def test_residual_returned_when_requested(tiny_archive):
+    D, w0 = preprocess(tiny_archive)
+    res = clean_cube(D, w0, _cfg(max_iter=2), want_residual=True)
+    assert res.residual is not None and res.residual.shape == D.shape
+    # Residual is model - data: subtracting it from amp*t recovers... sanity:
+    # at least it should have near-zero pulse relative to D's pulse power.
+    assert np.abs(res.residual).mean() < np.abs(D).mean() * 2
+
+
+class TestFindBadParts:
+    def test_defaults_are_noop(self):
+        w = np.ones((4, 4), np.float32)
+        w[0, :3] = 0
+        out, ns, nc = find_bad_parts(w, _cfg())
+        np.testing.assert_array_equal(out, w)
+        assert (ns, nc) == (0, 0)
+
+    def test_strictly_greater(self):
+        w = np.ones((2, 4), np.float32)
+        w[0, :2] = 0.0  # exactly half the channels of subint 0 zapped
+        out, ns, nc = find_bad_parts(w, _cfg(bad_subint=0.5))
+        assert ns == 0  # 0.5 > 0.5 is False
+        out, ns, nc = find_bad_parts(w, _cfg(bad_subint=0.49))
+        assert ns == 1 and out[0].sum() == 0
+
+    def test_channel_pass_uses_pre_sweep_snapshot(self):
+        # Subint zaps must NOT feed the channel fractions (reference takes the
+        # weights snapshot once, :310).
+        w = np.ones((4, 4), np.float32)
+        w[0, :] = 0.0       # subint 0 fully dead -> triggers subint pass anyway
+        w[1, 0] = 0.0       # channel 0: 2/4 zapped in snapshot
+        out, ns, nc = find_bad_parts(w, _cfg(bad_subint=0.9, bad_chan=0.6))
+        # channel 0 zapped frac in snapshot = 0.5, not > 0.6 -> survives even
+        # though post-sweep it would be... (it already was 0.5). Use tighter:
+        assert nc == 0
+        out2, _, nc2 = find_bad_parts(w, _cfg(bad_subint=0.9, bad_chan=0.4))
+        assert nc2 == 1 and np.all(out2[:, 0] == 0)

@@ -1,0 +1,164 @@
+"""Pin the numpy.ma landmine semantics the oracle inherits (SURVEY.md §8).
+
+These tests are the executable form of the empirical probes that established
+the reference's numerically subtle behaviors; the JAX backend must reproduce
+exactly these (tests/test_equivalence.py closes that loop).
+"""
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.backends.numpy_backend import (
+    NumpyCleaner,
+    comprehensive_stats,
+    fit_template,
+    robust_scale,
+)
+
+
+def _masked(data, mask):
+    return np.ma.masked_array(np.asarray(data, np.float32), mask=mask)
+
+
+class TestRobustScale:
+    def test_plain_column_scaling(self):
+        arr = np.array([[0.0], [2.0], [4.0], [100.0]], np.float32)
+        out = robust_scale(arr, axis=0)
+        med, mad = 3.0, 2.0  # median of [0,2,4,100]=3; MAD=median(|x-3|)=median([3,1,1,97])=2
+        np.testing.assert_allclose(out[:, 0], (arr[:, 0] - med) / mad)
+        assert not isinstance(out, np.ma.MaskedArray)
+
+    def test_mad_zero_leaves_unscaled_deviations(self):
+        # L4: masked division by MAD==0 masks the result but leaves the
+        # numerator's data; after abs + threshold-divide (which skips masked
+        # positions) the leaked value is |x - med| un-normalised.
+        col = _masked([[1.0], [1.0], [-1.0], [7.0]], [[False], [False], [False], [True]])
+        out = robust_scale(col, axis=0)
+        assert out.mask.all()
+        np.testing.assert_array_equal(np.asarray(out)[:, 0], [0.0, 0.0, -2.0, 7.0])
+        # and the downstream abs + /thresh skip masked data entirely:
+        final = np.abs(out) / 5.0
+        np.testing.assert_array_equal(np.asarray(final)[:, 0], [0.0, 0.0, 2.0, 7.0])
+
+    def test_masked_entries_pass_through_raw(self):
+        col = _masked([[0.0], [2.0], [4.0], [100.0]], [[False], [False], [False], [True]])
+        out = robust_scale(col, axis=0)
+        # valid: (x-2)/2 ; masked position: raw 100 untouched by -med and /mad
+        np.testing.assert_array_equal(np.asarray(out)[:, 0], [-1.0, 0.0, 1.0, 100.0])
+        np.testing.assert_array_equal(out.mask[:, 0], [False, False, False, True])
+
+    def test_all_masked_column(self):
+        col = _masked([[5.0], [6.0]], [[True], [True]])
+        out = robust_scale(col, axis=0)
+        assert out.mask.all()
+        np.testing.assert_array_equal(np.asarray(out)[:, 0], [5.0, 6.0])
+
+    def test_axis1_matches_transposed_axis0(self, rng):
+        arr = rng.normal(size=(6, 9)).astype(np.float32)
+        out_rows = robust_scale(arr, axis=1)
+        out_cols_t = robust_scale(arr.T.copy(), axis=0).T
+        np.testing.assert_allclose(out_rows, out_cols_t, rtol=1e-6)
+
+
+class TestComprehensiveStats:
+    def _cfg(self, **kw):
+        return CleanConfig(backend="numpy", **kw)
+
+    def test_fully_masked_profiles_yield_nan_and_never_flag(self, rng):
+        # L3: an all-masked profile -> NaN test result -> NaN >= 1 is False.
+        data = rng.normal(size=(6, 8, 32)).astype(np.float32)
+        w = np.ones((6, 8), np.float32)
+        w[2, :] = 0.0  # whole subint pre-zapped
+        weighted = data * w[..., None]
+        mask = np.repeat(np.expand_dims(~w.astype(bool), 2), 32, axis=2)
+        stats = comprehensive_stats(np.ma.masked_array(weighted, mask=mask), self._cfg())
+        assert np.isnan(stats[2, :]).all()
+        flag = stats >= 1
+        assert not flag[2, :].any()
+
+    def test_fft_diag_is_mask_blind_zeros(self, rng):
+        # L1: pre-zapped profiles contribute exactly 0.0 to the FFT
+        # diagnostic's plain (maskless) medians.
+        data = rng.normal(size=(5, 4, 16)).astype(np.float32)
+        w = np.ones((5, 4), np.float32)
+        w[1, 2] = 0.0
+        weighted = data * w[..., None]
+        mask = np.repeat(np.expand_dims(~w.astype(bool), 2), 16, axis=2)
+        ma = np.ma.masked_array(weighted, mask=mask)
+        centred = ma - np.expand_dims(ma.mean(axis=2), 2)
+        diag4 = np.max(np.abs(np.fft.rfft(centred, axis=2)), axis=2)
+        assert not isinstance(diag4, np.ma.MaskedArray)
+        assert diag4[1, 2] == 0.0
+
+    def test_outlier_profile_flagged(self, rng):
+        # A strong impulse trips std, ptp AND the FFT diagnostic (3 of 4), so
+        # the median-of-4 vote fires; a pure DC offset alone would only trip
+        # the mean diagnostic and stay unflagged — that's the algorithm.
+        data = rng.normal(size=(8, 16, 64)).astype(np.float32)
+        data[3, 5, 10] += 300.0
+        mask = np.zeros(data.shape, bool)
+        stats = comprehensive_stats(np.ma.masked_array(data, mask=mask), self._cfg())
+        assert stats[3, 5] >= 1.0
+        clean_frac = np.mean(stats < 1)
+        assert clean_frac > 0.95
+
+    def test_dc_only_offset_not_flagged(self, rng):
+        data = rng.normal(size=(8, 16, 64)).astype(np.float32)
+        data[3, 5, :] += 50.0
+        mask = np.zeros(data.shape, bool)
+        stats = comprehensive_stats(np.ma.masked_array(data, mask=mask), self._cfg())
+        assert stats[3, 5] < 1.0
+
+
+class TestFitTemplate:
+    def test_closed_form_matches_leastsq(self, rng):
+        import scipy.optimize
+
+        t = rng.normal(size=64).astype(np.float32)
+        D = rng.normal(size=(3, 4, 64)).astype(np.float32)
+        _amp, resid = fit_template(D, t, (0.0, 0.0, 1.0))
+        for s in range(3):
+            for c in range(4):
+                prof = D[s, c]
+                params, _status = scipy.optimize.leastsq(lambda a: a * t - prof, [1.0])
+                np.testing.assert_allclose(
+                    resid[s, c], params[0] * t - prof, rtol=2e-4, atol=2e-5)
+
+    def test_degenerate_template_amp_one(self):
+        D = np.ones((2, 2, 8), np.float32)
+        amp, resid = fit_template(D, np.zeros(8, np.float32), (0.0, 0.0, 1.0))
+        np.testing.assert_array_equal(amp, 1.0)
+        np.testing.assert_array_equal(resid, -D)
+
+    def test_pulse_region_reads_scale_first(self):
+        # L5: pulse_region is (scale, start, end) per the code, not the help.
+        D = np.zeros((1, 1, 8), np.float32)
+        D[0, 0] = np.arange(8)
+        t = np.zeros(8, np.float32)
+        _amp, resid = fit_template(D, t, (0.5, 2.0, 5.0))
+        expect = -np.arange(8, dtype=np.float32)
+        expect[2:5] *= 0.5
+        np.testing.assert_array_equal(resid[0, 0], expect)
+
+
+class TestStepSemantics:
+    def test_prezapped_profiles_stay_zapped_not_reflagged(self, rng):
+        D = rng.normal(size=(6, 8, 32)).astype(np.float32)
+        w0 = np.ones((6, 8), np.float32)
+        w0[4, 1] = 0.0
+        cleaner = NumpyCleaner(D, w0, CleanConfig(backend="numpy"))
+        test, new_w = cleaner.step(w0)
+        assert new_w[4, 1] == 0.0
+        # weights only move from w0 to 0, never resurrect
+        assert np.all((new_w == w0) | (new_w == 0))
+
+    def test_nonunit_weights_scale_data(self, rng):
+        # apply_weights multiplies by the raw weight value (:290-296).
+        D = rng.normal(size=(4, 4, 32)).astype(np.float32)
+        w_a = np.ones((4, 4), np.float32)
+        w_b = np.full((4, 4), 2.0, np.float32)
+        ta, _ = NumpyCleaner(D, w_a, CleanConfig(backend="numpy")).step(w_a)
+        tb, _ = NumpyCleaner(D, w_b, CleanConfig(backend="numpy")).step(w_b)
+        # Uniform weight rescaling cancels in the robust scalers
+        np.testing.assert_allclose(ta, tb, rtol=1e-5)

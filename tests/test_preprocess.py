@@ -1,0 +1,70 @@
+import numpy as np
+
+from iterative_cleaner_tpu.io.synthetic import make_archive, pulse_profile, RFISpec
+from iterative_cleaner_tpu.ops.preprocess import (
+    baseline_window,
+    dispersion_shifts,
+    preprocess,
+    pscrunch,
+    redisperse_cube,
+    roll_cube,
+)
+from iterative_cleaner_tpu.io.base import STATE_COHERENCE, STATE_STOKES
+
+
+def test_roll_cube_roundtrip(rng):
+    cube = rng.normal(size=(3, 5, 32)).astype(np.float32)
+    shifts = rng.integers(0, 32, size=5)
+    back = roll_cube(roll_cube(cube, shifts), shifts, inverse=True)
+    np.testing.assert_array_equal(back, cube)
+
+
+def test_dispersion_shifts_zero_dm():
+    s = dispersion_shifts(np.linspace(100, 200, 8), 0.0, 0.5, 128, 150.0)
+    assert np.all(s == 0)
+
+
+def test_dispersion_shifts_monotone_low_freq_lags():
+    freqs = np.linspace(110, 190, 16)
+    s = dispersion_shifts(freqs, 30.0, 0.7, 1024, 150.0)
+    # Lower frequencies have larger delay -> larger dedispersion rotation.
+    raw = (1.0 / 2.41e-4) * 30.0 * (freqs ** -2 - 150.0 ** -2) / 0.7 * 1024
+    np.testing.assert_array_equal(s, np.round(raw).astype(np.int64) % 1024)
+
+
+def test_pscrunch_states(rng):
+    d = rng.normal(size=(2, 4, 3, 8)).astype(np.float32)
+    np.testing.assert_array_equal(pscrunch(d, STATE_STOKES), d[:, 0])
+    np.testing.assert_array_equal(pscrunch(d, STATE_COHERENCE), d[:, 0] + d[:, 1])
+
+
+def test_baseline_window_finds_offpulse():
+    nbin = 256
+    prof = np.zeros(nbin)
+    prof[60:80] = 10.0  # on-pulse
+    start, width = baseline_window(prof)
+    window = (start + np.arange(width)) % nbin
+    assert not np.any((window >= 60) & (window < 80))
+
+
+def test_preprocess_aligns_pulse():
+    """After preprocessing a dispersed archive, the per-channel pulse peaks
+    line up (dedispersion worked) and baselines are near zero."""
+    ar = make_archive(nsub=4, nchan=32, nbin=256, seed=3, rfi=None, snr=80.0)
+    D, w0 = preprocess(ar)
+    assert D.shape == (4, 32, 256) and D.dtype == np.float32
+    peaks = D.mean(axis=0).argmax(axis=1)
+    ref_peak = pulse_profile(256).argmax()
+    spread = np.abs(((peaks - ref_peak) + 128) % 256 - 128)
+    assert np.max(spread) <= 2
+    # Baseline (off-pulse) close to zero after removal.
+    off = np.abs(((np.arange(256) - ref_peak) + 128) % 256 - 128) > 40
+    assert np.abs(D[:, :, off].mean()) < 0.05
+
+
+def test_redisperse_inverts():
+    ar = make_archive(nsub=2, nchan=16, nbin=128, seed=5, rfi=None)
+    D, _ = preprocess(ar)
+    round_trip = redisperse_cube(ar, D)
+    shifts = dispersion_shifts(ar.freqs, ar.dm, ar.period, ar.nbin, ar.centre_frequency)
+    np.testing.assert_array_equal(roll_cube(round_trip, shifts), D)
