@@ -1,0 +1,167 @@
+"""The JAX/TPU backend — the point of the project.
+
+One host→device transfer of the preprocessed cube; the whole per-iteration
+pipeline (template build → closed-form fit/subtract → four diagnostics →
+robust scalers → zap map) is a single jitted kernel (SURVEY.md §7.M2).  Two
+execution modes:
+
+- **stepwise** (default): one jit call per iteration, convergence bookkeeping
+  on host — print/log parity with the reference loop, still ~zero interpreter
+  overhead per step.
+- **fused** (``cfg.fused``): the entire convergence loop runs on device as a
+  ``lax.while_loop`` carrying a fixed (max_iter+1, nsub, nchan) weight-history
+  ring buffer for the full-history cycle detection (§8.L10) — one dispatch
+  for the whole clean, the benchmark configuration.
+
+Dedispersion does not appear anywhere in the loop: all four diagnostics are
+circular-shift invariant (§8.L8), so the kernel works entirely in the
+dedispersed frame the host precompute produced.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.ops.stats import comprehensive_stats
+from iterative_cleaner_tpu.ops.template import build_template, fit_and_subtract
+
+
+@partial(
+    jax.jit, static_argnames=("chanthresh", "subintthresh", "pulse_region")
+)
+def clean_step(D, w0, valid, w_prev, *, chanthresh, subintthresh, pulse_region):
+    """One cleaning iteration as a pure function (jit-compiled once).
+
+    w_prev shapes the template (previous iteration's zaps); the stats always
+    run against the frozen original weights w0 (§8.L11).
+    """
+    template = build_template(D, w_prev)
+    _amp, resid = fit_and_subtract(D, template, pulse_region)
+    weighted = resid * w0[..., None]
+    test = comprehensive_stats(weighted, valid, chanthresh, subintthresh)
+    # set_weights_archive on an original-weights clone: zap where test >= 1;
+    # NaN >= 1 is False -> never flags (§8.L3).
+    new_w = jnp.where(test >= 1.0, 0.0, w0)
+    return test, new_w, resid
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_iter", "chanthresh", "subintthresh", "pulse_region"),
+)
+def fused_clean(D, w0, valid, *, max_iter, chanthresh, subintthresh, pulse_region):
+    """The whole convergence loop on device (lax.while_loop).
+
+    Carry: (x, w_prev, history, test, loops, done).  history[0] is the
+    pre-loop weights — included in the cycle detection exactly as the
+    reference seeds test_weights with them (iterative_cleaner.py:77-78).
+    """
+    nsub, nchan = w0.shape
+    history0 = jnp.zeros((max_iter + 1, nsub, nchan), w0.dtype).at[0].set(w0)
+
+    step = partial(
+        clean_step,
+        chanthresh=chanthresh,
+        subintthresh=subintthresh,
+        pulse_region=pulse_region,
+    )
+
+    def cond(carry):
+        x, _w, _h, _t, _r, _l, done = carry
+        return (~done) & (x < max_iter)
+
+    def body(carry):
+        x, w_prev, history, _test, _resid, _loops, _done = carry
+        x = x + 1
+        test, new_w, resid = step(D, w0, valid, w_prev)
+        row_live = jnp.arange(max_iter + 1) < x  # rows 0..x-1 are populated
+        hit = jnp.any(
+            row_live & jnp.all(new_w[None] == history, axis=(1, 2))
+        )
+        history = history.at[x].set(new_w)
+        loops = jnp.where(hit, x, max_iter)
+        return x, new_w, history, test, resid, loops, hit
+
+    test0 = jnp.zeros_like(w0)
+    resid0 = jnp.zeros_like(D)
+    x, w_final, history, test, resid, loops, done = jax.lax.while_loop(
+        cond, body, (0, w0, history0, test0, resid0, max_iter, False)
+    )
+    return test, w_final, loops, done, x, resid
+
+
+def _x64_dtype(cfg: CleanConfig):
+    """cfg.x64 requires jax_enable_x64 to be set by the caller (env
+    JAX_ENABLE_X64=1 or jax.config) — we refuse to flip process-global state
+    mid-run, since it would silently retype every other computation in the
+    process."""
+    if not cfg.x64:
+        return jnp.float32
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "CleanConfig(x64=True) needs float64 support enabled before any "
+            "JAX computation: set JAX_ENABLE_X64=1 or "
+            "jax.config.update('jax_enable_x64', True) at startup")
+    return jnp.float64
+
+
+class JaxCleaner:
+    """Stepwise backend: same protocol as NumpyCleaner, device-resident."""
+
+    def __init__(self, D: np.ndarray, w0: np.ndarray, cfg: CleanConfig) -> None:
+        self.cfg = cfg
+        dtype = _x64_dtype(cfg)
+        self._D = jax.device_put(jnp.asarray(D, dtype))
+        self._w0 = jax.device_put(jnp.asarray(w0, dtype))
+        self._valid = jax.device_put(jnp.asarray(w0 != 0))
+        self._residual = None
+
+    def step(self, w_prev: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        w_prev = jnp.asarray(w_prev, self._w0.dtype)
+        test, new_w, resid = clean_step(
+            self._D,
+            self._w0,
+            self._valid,
+            w_prev,
+            chanthresh=float(self.cfg.chanthresh),
+            subintthresh=float(self.cfg.subintthresh),
+            pulse_region=tuple(self.cfg.pulse_region),
+        )
+        self._residual = resid  # stays on device unless fetched
+        return np.asarray(test), np.asarray(new_w)
+
+    def residual(self) -> np.ndarray | None:
+        return None if self._residual is None else np.asarray(self._residual)
+
+
+def run_fused(D, w0, cfg: CleanConfig, want_residual: bool = False):
+    """One-dispatch clean; returns (test, weights, loops, converged, iters[,
+    residual]) as host values.  Accepts numpy or device-resident arrays (pass
+    device arrays to keep the cube upload out of timing loops)."""
+    dtype = _x64_dtype(cfg)
+    D = jnp.asarray(D, dtype)
+    w0 = jnp.asarray(w0, dtype)
+    test, w_final, loops, done, x, resid = fused_clean(
+        D,
+        w0,
+        w0 != 0,
+        max_iter=int(cfg.max_iter),
+        chanthresh=float(cfg.chanthresh),
+        subintthresh=float(cfg.subintthresh),
+        pulse_region=tuple(cfg.pulse_region),
+    )
+    out = (
+        np.asarray(test),
+        np.asarray(w_final),
+        int(loops),
+        bool(done),
+        int(x),
+    )
+    if want_residual:
+        out = out + (np.asarray(resid),)
+    return out

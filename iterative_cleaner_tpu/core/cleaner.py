@@ -59,7 +59,26 @@ def clean_cube(
 
     D: (nsub, nchan, nbin) float32 — pscrunched, baseline-removed,
     dedispersed.  w0: (nsub, nchan) float32 original weights.
+
+    With ``cfg.fused`` (jax backend only) the whole loop runs as one device
+    dispatch; per-iteration history/progress is not tracked in that mode
+    (that is its point), so ``iterations`` and ``history`` come back empty.
     """
+    if cfg.fused:
+        if cfg.backend != "jax":
+            raise ValueError("CleanConfig(fused=True) requires backend='jax'")
+        from iterative_cleaner_tpu.backends.jax_backend import run_fused
+
+        out = run_fused(D, w0, cfg, want_residual=want_residual)
+        test, w_final, loops, done, _x = out[:5]
+        return CleanResult(
+            weights=w_final,
+            test_results=test,
+            loops=loops,
+            converged=done,
+            residual=out[5] if want_residual else None,
+        )
+
     backend = make_backend(D, w0, cfg)
     w0 = np.asarray(w0, dtype=np.float32)
 

@@ -71,6 +71,10 @@ class PsrchiveIO:  # pragma: no cover - exercised only with real psrchive
         ar = _psr.Archive_load(archive.filename)
         nsub, npol, nchan, _ = archive.data.shape
         if ar.get_npol() != npol:
+            if npol != 1:
+                raise ValueError(
+                    f"cannot write {npol}-pol data into a "
+                    f"{ar.get_npol()}-pol source archive")
             ar.pscrunch()
         for isub in range(nsub):
             integ = ar.get_Integration(isub)

@@ -1,0 +1,53 @@
+"""Masked reductions for JAX — numpy.ma semantics as explicit value+validity.
+
+JAX has no masked arrays; these primitives reproduce the exact numpy.ma
+behaviors the oracle inherits (SURVEY.md §7 "hard parts" #1):
+
+- medians over only the valid entries, even-count averaging, NaN when a
+  row/column has no valid entries (→ "never flagged", §8.L3);
+- ``np.median``'s any-NaN-poisons-the-result rule for the plain (mask-blind)
+  FFT-diagnostic path.
+
+All functions are dtype-polymorphic (python-scalar literals only) so the same
+code runs f32 on TPU and f64 under ``jax_enable_x64`` for bit-parity
+debugging.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def masked_median(x: jnp.ndarray, valid: jnp.ndarray, axis: int):
+    """Median over valid entries along ``axis`` (np.ma.median semantics).
+
+    Returns (median, n_valid); median is NaN where n_valid == 0.  Sort with
+    +inf padding, then count-based middle selection with even-count
+    averaging.
+    """
+    x = jnp.moveaxis(x, axis, -1)
+    valid = jnp.moveaxis(valid, axis, -1)
+    size = x.shape[-1]
+    filled = jnp.where(valid, x, jnp.inf)
+    srt = jnp.sort(filled, axis=-1)
+    n = jnp.sum(valid, axis=-1)
+    lo = jnp.clip((n - 1) // 2, 0, size - 1)
+    hi = jnp.clip(n // 2, 0, size - 1)
+    lo_v = jnp.take_along_axis(srt, lo[..., None], axis=-1)[..., 0]
+    hi_v = jnp.take_along_axis(srt, hi[..., None], axis=-1)[..., 0]
+    med = (lo_v + hi_v) * 0.5
+    return jnp.where(n > 0, med, jnp.nan), n
+
+
+def nan_propagating_median(x: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Plain np.median semantics: even-count averaging, NaN if any NaN.
+
+    (np.median explicitly returns NaN when the reduction window contains one;
+    a naive sort-and-pick would not, since NaN sorts last.)
+    """
+    size = x.shape[axis]
+    srt = jnp.sort(x, axis=axis)
+    lo = jnp.take(srt, (size - 1) // 2, axis=axis)
+    hi = jnp.take(srt, size // 2, axis=axis)
+    med = (lo + hi) * 0.5
+    return jnp.where(jnp.isnan(x).any(axis=axis), jnp.nan, med)

@@ -78,9 +78,7 @@ def baseline_window(total_profile: np.ndarray, frac: float = BASELINE_FRAC) -> t
     """(start, width) of the circular window minimising the running mean."""
     nbin = total_profile.shape[-1]
     width = max(1, int(round(frac * nbin)))
-    kernel = np.zeros(nbin)
-    kernel[:width] = 1.0 / width
-    # Circular running mean via FFT-free cumulative trick.
+    # Circular running mean via the cumulative-sum trick.
     ext = np.concatenate([total_profile, total_profile[:width]])
     csum = np.concatenate([[0.0], np.cumsum(ext)])
     means = (csum[width : width + nbin] - csum[:nbin]) / width

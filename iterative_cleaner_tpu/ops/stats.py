@@ -1,0 +1,119 @@
+"""The comprehensive-stats kernel in JAX — hot loop #2, TPU-resident.
+
+Re-designs the reference's ``comprehensive_stats`` + per-row/column scaler
+loops (iterative_cleaner.py:180-255; SURVEY.md §3.4) as fused array ops: the
+O(nchan + nsub) Python loop bodies become two batched sort-based masked
+medians, and the four diagnostics become reductions + one batched rfft along
+the bin axis (XLA FFT on the TPU).
+
+The numpy.ma landmines are reproduced with explicit value+validity
+arithmetic; the exact scaled-value rules (verified empirically against
+numpy 2.0.2, tests/test_landmines.py + tests/test_equivalence.py):
+
+masked diagnostics (std / mean / ptp — "type A" scaling):
+  valid entry, MAD != 0 : |x − med| / MAD / thresh
+  valid entry, MAD == 0 : |x − med|          (masked division leaves the
+                                              numerator; abs still applies;
+                                              the /thresh skips masked data)
+  masked entry          : |x|                (raw garbage data: 0.0 for
+                                              std/mean, 1e20 for ptp — the
+                                              MaskedArray fill value)
+plain diagnostic (max |rfft| — "type B", mask-blind per §8.L1):
+  IEEE throughout: (x − med)/MAD with MAD == 0 gives ±inf / NaN.
+
+Downstream of the scalers the masks are gone (mask-drop at the max step,
+§8.L2): element-wise max of the channel/subint scalings, then a NaN-
+propagating median across the four diagnostics.  NaN ≥ 1 is False, so
+fully-masked profiles are never flagged (§8.L3).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from iterative_cleaner_tpu.ops.masked import masked_median, nan_propagating_median
+
+# numpy.ma's default float fill value — the raw data np.ma.ptp leaves at
+# fully-masked positions (only reachable for already-zapped profiles).
+MA_FILL = 1e20
+
+
+def diagnostics(weighted: jnp.ndarray, valid: jnp.ndarray):
+    """The four per-profile outlier diagnostics along the bin axis.
+
+    weighted: (nsub, nchan, nbin) residuals pre-scaled by the original
+    weights; valid: (nsub, nchan) = w0 != 0.  Profiles are entirely valid or
+    entirely masked (the mask comes from per-profile weights), so the masked
+    reductions collapse to plain reductions + a fill at masked profiles.
+    """
+    mean = jnp.mean(weighted, axis=-1)
+    centred = weighted - mean[..., None]
+    std = jnp.sqrt(jnp.mean(centred * centred, axis=-1))
+    ptp = jnp.max(weighted, axis=-1) - jnp.min(weighted, axis=-1)
+    # Mask-blind FFT diagnostic (§8.L1): masked profiles were pre-zeroed by
+    # the weight scaling, and the masked mean's raw data is 0.0, so they
+    # contribute exactly |rfft(0)| = 0.
+    fft_mag = jnp.abs(jnp.fft.rfft(centred, axis=-1))
+    fft_diag = jnp.max(fft_mag, axis=-1)
+
+    d_std = jnp.where(valid, std, 0.0)
+    d_mean = jnp.where(valid, mean, 0.0)
+    d_ptp = jnp.where(valid, ptp, MA_FILL)
+    return d_std, d_mean, d_ptp, fft_diag
+
+
+def scale_masked(diag: jnp.ndarray, valid: jnp.ndarray, axis: int, thresh: float):
+    """Type-A robust scaling along ``axis`` with numpy.ma leak semantics.
+
+    Returns the final |scaled|/thresh *data* (plain array — the caller is
+    downstream of the mask-drop).
+    """
+    med, n = masked_median(diag, valid, axis=axis)
+    has = n > 0
+    med_b = jnp.expand_dims(med, axis)
+    has_b = jnp.expand_dims(has, axis)
+    r = diag - med_b
+    mad, _ = masked_median(jnp.abs(r), valid, axis=axis)
+    mad_ok = has & (mad != 0) & ~jnp.isnan(mad)
+    mad_ok_b = jnp.expand_dims(mad_ok, axis)
+    mad_b = jnp.expand_dims(jnp.where(mad_ok, mad, 1.0), axis)
+    # Two-division op order matches the reference: (r/MAD), abs, /thresh.
+    scaled_ok = jnp.abs(r / mad_b) / thresh
+    scaled_valid = jnp.where(mad_ok_b, scaled_ok, jnp.abs(r))
+    return jnp.where(valid & has_b, scaled_valid, jnp.abs(diag))
+
+
+def scale_plain(diag: jnp.ndarray, axis: int, thresh: float):
+    """Type-B scaling: plain IEEE arithmetic, no mask anywhere (§8.L1)."""
+    med = nan_propagating_median(diag, axis=axis)
+    r = diag - jnp.expand_dims(med, axis)
+    mad = nan_propagating_median(jnp.abs(r), axis=axis)
+    return jnp.abs(r / jnp.expand_dims(mad, axis)) / thresh
+
+
+def comprehensive_stats(
+    weighted: jnp.ndarray,
+    valid: jnp.ndarray,
+    chanthresh: float,
+    subintthresh: float,
+) -> jnp.ndarray:
+    """weighted residual cube → per-profile outlier score (plain array).
+
+    axis=0 scaling compares a profile against others in the same *channel*
+    (across subints, / chanthresh); axis=1 against the same *subint* (across
+    channels, / subintthresh) — reference iterative_cleaner.py:221-223.
+    """
+    d_std, d_mean, d_ptp, d_fft = diagnostics(weighted, valid)
+
+    combined = []
+    for diag in (d_std, d_mean, d_ptp):
+        per_chan = scale_masked(diag, valid, axis=0, thresh=chanthresh)
+        per_subint = scale_masked(diag, valid, axis=1, thresh=subintthresh)
+        combined.append(jnp.maximum(per_chan, per_subint))  # mask-drop (§8.L2)
+    combined.append(
+        jnp.maximum(
+            scale_plain(d_fft, axis=0, thresh=chanthresh),
+            scale_plain(d_fft, axis=1, thresh=subintthresh),
+        )
+    )
+    return nan_propagating_median(jnp.stack(combined, axis=0), axis=0)

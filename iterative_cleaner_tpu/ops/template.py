@@ -1,0 +1,54 @@
+"""Template build + closed-form amplitude fit in JAX — hot loop #1.
+
+The reference performs nsub×nchan Python→MINPACK round-trips per iteration
+(iterative_cleaner.py:258-287; SURVEY.md §3.3).  The model is linear in its
+single parameter, so the least-squares solution is the closed form
+``amp = <t, p> / <t, t>`` (equal to leastsq to ~1e-9, §8.L7) — one einsum on
+the MXU for all profiles at once.
+
+einsums run at Precision.HIGHEST: the fit feeds a ≥-threshold decision, so we
+want true f32 accumulation, not bf16 MXU passes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from iterative_cleaner_tpu.config import pulse_region_active
+
+_PREC = lax.Precision.HIGHEST
+
+
+def build_template(D: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
+    """Weighted scrunch over (subint, channel): PSRCHIVE's fscrunch+tscrunch
+    collapse up to overall scale, which cancels out of amp·t (§8.L7 — the
+    reference's ×10000 included)."""
+    return jnp.einsum("sc,scb->b", weights, D, precision=_PREC)
+
+
+def fit_and_subtract(
+    D: jnp.ndarray, template: jnp.ndarray, pulse_region
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """All-profile amplitude fit + residual (model − data, reference :276).
+
+    pulse_region is static config: (scale, start, end) per the reference's
+    code-order semantics (§8.L5); applied as a static slice so XLA fuses it.
+    """
+    tt = jnp.einsum("b,b->", template, template, precision=_PREC)
+    tp = jnp.einsum("scb,b->sc", D, template, precision=_PREC)
+    ok = (tt != 0) & jnp.isfinite(tt)
+    # leastsq on a flat objective returns its initial guess amp = 1 (§8.L7).
+    amp = jnp.where(ok, tp / jnp.where(ok, tt, 1.0), 1.0)
+    resid = amp[..., None] * template - D
+    if pulse_region_active(pulse_region):
+        import numpy as np
+
+        # Static bin mask built with a real Python slice so negative /
+        # out-of-range indices behave exactly as the reference's
+        # err2[start:end] *= scale (§8.L5); XLA fuses the multiply.
+        scale, start, end = pulse_region
+        bin_scale = np.ones(D.shape[-1], dtype=np.float32)
+        bin_scale[int(start) : int(end)] = scale
+        resid = resid * jnp.asarray(bin_scale, dtype=resid.dtype)
+    return amp, resid

@@ -1,0 +1,127 @@
+"""Backend equivalence: the JAX kernel must reproduce the numpy oracle's flag
+masks exactly (flag-mask IoU == 1.0, the driver metric in BASELINE.md)."""
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube
+from iterative_cleaner_tpu.io.synthetic import make_archive, RFISpec
+from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+
+def mask_iou(w_a: np.ndarray, w_b: np.ndarray) -> float:
+    """IoU of the zapped sets; 1.0 when both zap exactly the same profiles."""
+    za, zb = (w_a == 0), (w_b == 0)
+    union = np.logical_or(za, zb).sum()
+    if union == 0:
+        return 1.0
+    return float(np.logical_and(za, zb).sum() / union)
+
+
+def run_both(archive, **cfg_kw):
+    D, w0 = preprocess(archive)
+    res_np = clean_cube(D, w0, CleanConfig(backend="numpy", **cfg_kw))
+    res_jx = clean_cube(D, w0, CleanConfig(backend="jax", **cfg_kw))
+    return res_np, res_jx
+
+
+def assert_equivalent(res_np, res_jx):
+    assert mask_iou(res_np.weights, res_jx.weights) == 1.0
+    np.testing.assert_array_equal(res_np.weights, res_jx.weights)
+    assert res_np.loops == res_jx.loops
+    assert res_np.converged == res_jx.converged
+    assert len(res_np.history) == len(res_jx.history)
+    for h_np, h_jx in zip(res_np.history, res_jx.history):
+        np.testing.assert_array_equal(h_np, h_jx)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_masks_identical_across_seeds(seed):
+    ar = make_archive(nsub=8, nchan=32, nbin=128, seed=seed)
+    assert_equivalent(*run_both(ar, max_iter=5))
+
+
+def test_masks_identical_config1_scale():
+    ar = make_archive(nsub=8, nchan=64, nbin=256, seed=42)
+    assert_equivalent(*run_both(ar, max_iter=5))
+
+
+def test_masks_identical_heavy_rfi():
+    ar = make_archive(
+        nsub=12, nchan=32, nbin=128, seed=9,
+        rfi=RFISpec(n_profile_spikes=20, n_dc_profiles=10, n_bad_channels=3,
+                    n_bad_subints=2, n_prezapped=6, amplitude=60.0))
+    assert_equivalent(*run_both(ar, max_iter=6))
+
+
+def test_masks_identical_prezapped_subint():
+    ar = make_archive(nsub=8, nchan=24, nbin=64, seed=3, rfi=None)
+    ar.weights[5, :] = 0.0  # fully dead subint: NaN row, never re-flagged
+    res_np, res_jx = run_both(ar, max_iter=4)
+    assert_equivalent(res_np, res_jx)
+    assert np.isnan(res_np.test_results[5]).all()
+    assert np.isnan(res_jx.test_results[5]).all()
+
+
+def test_masks_identical_constant_channel_mad_zero():
+    # A channel whose data is identical across subints drives the per-channel
+    # MAD to zero -> the masked-division leak path (§8.L4) in both backends.
+    ar = make_archive(nsub=8, nchan=16, nbin=64, seed=11, rfi=None)
+    ar.data[:, :, 4, :] = ar.data[0:1, :, 4, :]
+    assert_equivalent(*run_both(ar, max_iter=4))
+
+
+def test_masks_identical_pulse_region():
+    ar = make_archive(nsub=6, nchan=16, nbin=128, seed=5)
+    assert_equivalent(*run_both(ar, max_iter=4, pulse_region=(0.1, 20.0, 90.0)))
+
+
+def test_masks_identical_tight_thresholds():
+    ar = make_archive(nsub=8, nchan=32, nbin=128, seed=8)
+    assert_equivalent(*run_both(ar, max_iter=8, chanthresh=3.0, subintthresh=3.0))
+
+
+def test_test_results_close_where_finite():
+    ar = make_archive(nsub=8, nchan=32, nbin=128, seed=2)
+    res_np, res_jx = run_both(ar, max_iter=3)
+    a, b = res_np.test_results, res_jx.test_results
+    np.testing.assert_array_equal(np.isnan(a), np.isnan(b))
+    finite = np.isfinite(a) & np.isfinite(b)
+    np.testing.assert_allclose(a[finite], b[finite], rtol=2e-4, atol=1e-5)
+
+
+def test_fused_matches_stepwise():
+    from iterative_cleaner_tpu.backends.jax_backend import run_fused
+
+    ar = make_archive(nsub=8, nchan=32, nbin=128, seed=4)
+    D, w0 = preprocess(ar)
+    cfg = CleanConfig(backend="jax", max_iter=5)
+    res = clean_cube(D, w0, cfg, want_residual=True)
+    test_f, w_f, loops_f, conv_f, _iters_f, resid_f = run_fused(
+        D, w0, cfg, want_residual=True)
+    np.testing.assert_array_equal(res.weights, w_f)
+    assert res.loops == loops_f
+    assert res.converged == conv_f
+    nan_eq = np.isnan(res.test_results) == np.isnan(test_f)
+    assert nan_eq.all()
+    fin = np.isfinite(test_f)
+    np.testing.assert_allclose(res.test_results[fin], test_f[fin], rtol=1e-6)
+    np.testing.assert_array_equal(res.residual, resid_f)
+
+
+def test_fused_via_clean_cube():
+    ar = make_archive(nsub=6, nchan=16, nbin=64, seed=13)
+    D, w0 = preprocess(ar)
+    res_step = clean_cube(D, w0, CleanConfig(backend="jax", max_iter=4))
+    res_fused = clean_cube(D, w0, CleanConfig(backend="jax", max_iter=4, fused=True))
+    np.testing.assert_array_equal(res_step.weights, res_fused.weights)
+    assert res_step.loops == res_fused.loops
+    assert res_fused.iterations == [] and res_fused.history == []
+
+
+def test_fused_requires_jax_backend():
+    ar = make_archive(nsub=4, nchan=8, nbin=32, seed=1, rfi=None)
+    D, w0 = preprocess(ar)
+    with pytest.raises(ValueError):
+        clean_cube(D, w0, CleanConfig(backend="numpy", fused=True))

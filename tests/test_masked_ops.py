@@ -1,0 +1,60 @@
+"""Property tests: the JAX masked primitives against numpy.ma ground truth."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from iterative_cleaner_tpu.ops.masked import masked_median, nan_propagating_median
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 8])
+@pytest.mark.parametrize("seed", range(4))
+def test_masked_median_matches_ma(n, seed):
+    rng = np.random.default_rng(seed * 100 + n)
+    x = rng.normal(size=(5, n)).astype(np.float32)
+    mask = rng.random((5, n)) < 0.35
+    med, cnt = masked_median(jnp.asarray(x), jnp.asarray(~mask), axis=1)
+    med = np.asarray(med)
+    for i in range(5):
+        expect = np.ma.median(np.ma.masked_array(x[i], mask=mask[i]))
+        if np.ma.is_masked(expect):
+            assert np.isnan(med[i])
+            assert cnt[i] == 0
+        else:
+            np.testing.assert_allclose(med[i], float(expect), rtol=1e-6)
+
+
+def test_masked_median_all_masked_row():
+    x = jnp.ones((2, 4))
+    med, cnt = masked_median(x, jnp.zeros((2, 4), bool), axis=1)
+    assert np.isnan(np.asarray(med)).all()
+    assert np.asarray(cnt).sum() == 0
+
+
+def test_masked_median_even_count_averages():
+    x = jnp.asarray([[1.0, 9.0, 3.0, 7.0, 100.0]])
+    valid = jnp.asarray([[True, True, True, True, False]])
+    med, _ = masked_median(x, valid, axis=1)
+    assert float(med[0]) == 5.0  # (3 + 7) / 2
+
+
+@pytest.mark.parametrize("n", [1, 2, 5, 6])
+def test_nan_propagating_median_matches_np(n):
+    rng = np.random.default_rng(n)
+    x = rng.normal(size=(4, n)).astype(np.float32)
+    got = np.asarray(nan_propagating_median(jnp.asarray(x), axis=1))
+    np.testing.assert_allclose(got, np.median(x, axis=1), rtol=1e-6)
+
+
+def test_nan_propagating_median_nan_poisons():
+    x = np.array([[1.0, np.nan, 2.0, 3.0], [1.0, 2.0, 3.0, 4.0]], np.float32)
+    got = np.asarray(nan_propagating_median(jnp.asarray(x), axis=1))
+    assert np.isnan(got[0]) and got[1] == 2.5
+
+
+def test_nan_propagating_median_inf_ok():
+    x = np.array([[1.0, np.inf, 2.0, np.inf]], np.float32)
+    got = np.asarray(nan_propagating_median(jnp.asarray(x), axis=1))
+    assert got[0] == np.inf  # (2 + inf)/2, as np.median gives
+    np.testing.assert_allclose(got, np.median(x, axis=1))
