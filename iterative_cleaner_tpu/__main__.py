@@ -1,0 +1,3 @@
+from iterative_cleaner_tpu.cli import main
+
+raise SystemExit(main())

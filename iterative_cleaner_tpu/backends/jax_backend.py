@@ -31,14 +31,14 @@ from iterative_cleaner_tpu.ops.stats import comprehensive_stats
 from iterative_cleaner_tpu.ops.template import build_template, fit_and_subtract
 
 
-@partial(
-    jax.jit, static_argnames=("chanthresh", "subintthresh", "pulse_region")
-)
-def clean_step(D, w0, valid, w_prev, *, chanthresh, subintthresh, pulse_region):
+@partial(jax.jit, static_argnames=("pulse_region",))
+def clean_step(D, w0, valid, w_prev, chanthresh, subintthresh, *, pulse_region):
     """One cleaning iteration as a pure function (jit-compiled once).
 
     w_prev shapes the template (previous iteration's zaps); the stats always
-    run against the frozen original weights w0 (§8.L11).
+    run against the frozen original weights w0 (§8.L11).  The thresholds are
+    traced scalars — a threshold sweep reuses one compilation; only
+    pulse_region (trace-time slicing) and shapes are static.
     """
     template = build_template(D, w_prev)
     _amp, resid = fit_and_subtract(D, template, pulse_region)
@@ -50,48 +50,49 @@ def clean_step(D, w0, valid, w_prev, *, chanthresh, subintthresh, pulse_region):
     return test, new_w, resid
 
 
-@partial(
-    jax.jit,
-    static_argnames=("max_iter", "chanthresh", "subintthresh", "pulse_region"),
-)
-def fused_clean(D, w0, valid, *, max_iter, chanthresh, subintthresh, pulse_region):
+@partial(jax.jit, static_argnames=("max_iter", "pulse_region", "want_residual"))
+def fused_clean(
+    D, w0, valid, chanthresh, subintthresh, *, max_iter, pulse_region,
+    want_residual=False,
+):
     """The whole convergence loop on device (lax.while_loop).
 
-    Carry: (x, w_prev, history, test, loops, done).  history[0] is the
-    pre-loop weights — included in the cycle detection exactly as the
-    reference seeds test_weights with them (iterative_cleaner.py:77-78).
+    Carry: (x, w_prev, history, test[, resid], loops, done).  history[0] is
+    the pre-loop weights — included in the cycle detection exactly as the
+    reference seeds test_weights with them (iterative_cleaner.py:77-78).  The
+    D-sized residual buffer is only carried when want_residual is set, so the
+    benchmark configuration does not pay a second cube of HBM.
     """
     nsub, nchan = w0.shape
     history0 = jnp.zeros((max_iter + 1, nsub, nchan), w0.dtype).at[0].set(w0)
 
-    step = partial(
-        clean_step,
-        chanthresh=chanthresh,
-        subintthresh=subintthresh,
-        pulse_region=pulse_region,
-    )
-
     def cond(carry):
-        x, _w, _h, _t, _r, _l, done = carry
-        return (~done) & (x < max_iter)
+        return (~carry[-1]) & (carry[0] < max_iter)
 
     def body(carry):
-        x, w_prev, history, _test, _resid, _loops, _done = carry
-        x = x + 1
-        test, new_w, resid = step(D, w0, valid, w_prev)
-        row_live = jnp.arange(max_iter + 1) < x  # rows 0..x-1 are populated
-        hit = jnp.any(
-            row_live & jnp.all(new_w[None] == history, axis=(1, 2))
+        x, w_prev, history = carry[0] + 1, carry[1], carry[2]
+        test, new_w, resid = clean_step(
+            D, w0, valid, w_prev, chanthresh, subintthresh,
+            pulse_region=pulse_region,
         )
+        row_live = jnp.arange(max_iter + 1) < x  # rows 0..x-1 are populated
+        hit = jnp.any(row_live & jnp.all(new_w[None] == history, axis=(1, 2)))
         history = history.at[x].set(new_w)
         loops = jnp.where(hit, x, max_iter)
-        return x, new_w, history, test, resid, loops, hit
+        if want_residual:
+            return x, new_w, history, test, resid, loops, hit
+        return x, new_w, history, test, loops, hit
 
     test0 = jnp.zeros_like(w0)
-    resid0 = jnp.zeros_like(D)
-    x, w_final, history, test, resid, loops, done = jax.lax.while_loop(
-        cond, body, (0, w0, history0, test0, resid0, max_iter, False)
-    )
+    init = (0, w0, history0, test0, max_iter, False)
+    if want_residual:
+        init = (0, w0, history0, test0, jnp.zeros_like(D), max_iter, False)
+    out = jax.lax.while_loop(cond, body, init)
+    if want_residual:
+        x, w_final, _h, test, resid, loops, done = out
+    else:
+        x, w_final, _h, test, loops, done = out
+        resid = None
     return test, w_final, loops, done, x, resid
 
 
@@ -128,8 +129,8 @@ class JaxCleaner:
             self._w0,
             self._valid,
             w_prev,
-            chanthresh=float(self.cfg.chanthresh),
-            subintthresh=float(self.cfg.subintthresh),
+            float(self.cfg.chanthresh),
+            float(self.cfg.subintthresh),
             pulse_region=tuple(self.cfg.pulse_region),
         )
         self._residual = resid  # stays on device unless fetched
@@ -150,10 +151,11 @@ def run_fused(D, w0, cfg: CleanConfig, want_residual: bool = False):
         D,
         w0,
         w0 != 0,
+        float(cfg.chanthresh),
+        float(cfg.subintthresh),
         max_iter=int(cfg.max_iter),
-        chanthresh=float(cfg.chanthresh),
-        subintthresh=float(cfg.subintthresh),
         pulse_region=tuple(cfg.pulse_region),
+        want_residual=want_residual,
     )
     out = (
         np.asarray(test),

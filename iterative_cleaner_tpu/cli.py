@@ -1,0 +1,112 @@
+"""Command-line interface.
+
+Every flag of the reference CLI (iterative_cleaner.py:15-41) plus the TPU
+framework extensions.  The ``--pulse_region`` help documents the *actual*
+argument order the code implements — the reference's help text has the order
+wrong (SURVEY.md §8.L5: replicate the code, fix the help).
+
+Run as ``python -m iterative_cleaner_tpu`` or the ``iterative-cleaner-tpu``
+console script.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from iterative_cleaner_tpu.config import CleanConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="iterative-cleaner-tpu",
+        description="TPU-native iterative surgical RFI cleaner for pulsar archives",
+    )
+    p.add_argument("archive", nargs="+", help="archives to clean (.npz, or .ar with psrchive)")
+    p.add_argument(
+        "-c", "--chanthresh", type=float, default=5, metavar="channel_threshold",
+        help="sigma threshold for a profile to stand out against others in "
+             "the same channel (default: 5)")
+    p.add_argument(
+        "-s", "--subintthresh", type=float, default=5, metavar="subint_threshold",
+        help="sigma threshold for a profile to stand out against others in "
+             "the same subint (default: 5)")
+    p.add_argument(
+        "-m", "--max_iter", type=int, default=5, metavar="maximum_iterations",
+        help="maximum number of cleaning iterations (default: 5; must be >= 1)")
+    p.add_argument("-z", "--print_zap", action="store_true",
+                   help="save a plot showing which profiles were zapped")
+    p.add_argument("-u", "--unload_res", action="store_true",
+                   help="save an archive containing the pulse-free residual")
+    p.add_argument("-p", "--pscrunch", action="store_true",
+                   help="pscrunch the output archive")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="do not print cleaning information")
+    p.add_argument("-l", "--no_log", action="store_true",
+                   help="do not append to clean.log")
+    p.add_argument(
+        "-r", "--pulse_region", nargs=3, type=float, default=[0, 0, 1],
+        metavar=("scaling_factor", "pulse_start", "pulse_end"),
+        help="suppress residuals in phase bins [pulse_start:pulse_end] "
+             "(dedispersed frame) by scaling_factor; 0 0 1 disables. NOTE: "
+             "the scaling factor comes FIRST — this is the order the "
+             "original implementation actually reads, despite its help text")
+    p.add_argument(
+        "-o", "--output", type=str, default="", metavar="output_filename",
+        help="output name; 'std' uses the pattern NAME.FREQ.MJD")
+    p.add_argument("--memory", action="store_true",
+                   help="compatibility no-op (this framework never mutates "
+                        "the in-memory archive, so no reload is ever needed)")
+    p.add_argument("--bad_chan", type=float, default=1,
+                   help="zap a whole channel when its zapped-subint fraction "
+                        "strictly exceeds this (default 1 = never)")
+    p.add_argument("--bad_subint", type=float, default=1,
+                   help="zap a whole subint when its zapped-channel fraction "
+                        "strictly exceeds this (default 1 = never)")
+    # --- TPU framework extensions ---
+    p.add_argument("--backend", choices=("numpy", "jax"), default="jax",
+                   help="compute backend (default: jax)")
+    p.add_argument("--fused", action="store_true",
+                   help="jax: run the whole iteration loop as one device "
+                        "dispatch (no per-loop progress output)")
+    p.add_argument("--x64", action="store_true",
+                   help="jax: float64 intermediates (requires JAX_ENABLE_X64=1)")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> CleanConfig:
+    return CleanConfig(
+        chanthresh=args.chanthresh,
+        subintthresh=args.subintthresh,
+        max_iter=args.max_iter,
+        pulse_region=tuple(args.pulse_region),
+        bad_chan=args.bad_chan,
+        bad_subint=args.bad_subint,
+        output=args.output,
+        pscrunch=args.pscrunch,
+        memory=args.memory,
+        unload_res=args.unload_res,
+        print_zap=args.print_zap,
+        quiet=args.quiet,
+        no_log=args.no_log,
+        backend=args.backend,
+        fused=args.fused,
+        x64=args.x64,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        cfg = config_from_args(args)
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    from iterative_cleaner_tpu.driver import run
+
+    reports = run(args.archive, cfg)
+    return 0 if all(r.error is None for r in reports) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

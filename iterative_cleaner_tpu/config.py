@@ -58,6 +58,8 @@ class CleanConfig:
             raise ValueError(f"max_iter must be >= 1, got {self.max_iter}")
         if self.backend not in ("numpy", "jax"):
             raise ValueError(f"unknown backend {self.backend!r}")
+        if self.fused and self.backend != "jax":
+            raise ValueError("fused=True requires backend='jax'")
         if len(self.pulse_region) != 3:
             raise ValueError("pulse_region must have exactly 3 elements")
         object.__setattr__(self, "pulse_region", tuple(float(v) for v in self.pulse_region))

@@ -42,7 +42,12 @@ class CleanResult:
 
     @property
     def rfi_frac(self) -> float:
-        return self.iterations[-1].rfi_frac if self.iterations else 0.0
+        if self.iterations:
+            return self.iterations[-1].rfi_frac
+        # Fused mode tracks no per-iteration info; derive from the final
+        # weights (identical to the stepwise final-iteration value: zapped
+        # entries are exactly 0.0).
+        return float((self.weights == 0).mean())
 
 
 ProgressFn = Callable[[IterationInfo], None]
@@ -65,8 +70,6 @@ def clean_cube(
     (that is its point), so ``iterations`` and ``history`` come back empty.
     """
     if cfg.fused:
-        if cfg.backend != "jax":
-            raise ValueError("CleanConfig(fused=True) requires backend='jax'")
         from iterative_cleaner_tpu.backends.jax_backend import run_fused
 
         out = run_fused(D, w0, cfg, want_residual=want_residual)

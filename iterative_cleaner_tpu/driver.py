@@ -1,0 +1,147 @@
+"""Per-archive driver: load → clean → side outputs → save.
+
+The host orchestration layer above the model (reference ``main()`` +
+``clean()``'s output plumbing, iterative_cleaner.py:44-61, 147-177): output
+naming modes, the residual archive, the zap plot, and the append-only
+clean.log audit trail.  One corrupt archive must not kill a batch
+(SURVEY.md §5 "failure detection"), so per-archive errors are isolated.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from dataclasses import dataclass
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.io.base import Archive, get_io
+from iterative_cleaner_tpu.models.surgical import SurgicalCleaner, SurgicalOutput
+
+
+def _ext(path: str) -> str:
+    return ".npz" if path.endswith(".npz") else ".ar"
+
+
+def output_name(cfg: CleanConfig, archive: Archive, path: str) -> str:
+    """Reference naming modes (iterative_cleaner.py:47-57):
+
+    - default: ``<original name>_cleaned<ext>`` (the reference appends to the
+      *full* original filename, extension included);
+    - ``-o std``: ``NAME.FREQ.MJD<ext>`` with FREQ %.3f and mid-MJD %f;
+    - ``-o <name>``: used verbatim.
+    """
+    if cfg.output == "":
+        return f"{path}_cleaned{_ext(path)}"
+    if cfg.output == "std":
+        return "%s.%.3f.%f%s" % (
+            archive.source,
+            archive.centre_frequency,
+            archive.mjd_mid,
+            _ext(path),
+        )
+    return cfg.output
+
+
+def residual_name(path: str, loops: int) -> str:
+    # Reference: "%s_residual_%s.ar" % (ar_name, loops)  (:161)
+    return f"{path}_residual_{loops}{_ext(path)}"
+
+
+@dataclass
+class ArchiveReport:
+    path: str
+    out_path: str | None
+    loops: int = 0
+    rfi_frac: float = 0.0
+    converged: bool = False
+    error: str | None = None
+
+
+def process_archive(
+    path: str,
+    cfg: CleanConfig,
+    log_dir: str = ".",
+    all_paths: list[str] | None = None,
+) -> ArchiveReport:
+    """Clean one archive.  ``all_paths`` is the full batch invocation (the
+    reference logs the entire args Namespace, archive list included, in every
+    log line — iterative_cleaner.py:173-176)."""
+    io = get_io(path)
+    archive = io.load(path)
+
+    def progress(info):
+        if not cfg.quiet:
+            print(f"Loop: {info.index}")
+            print(
+                "Differences to previous weights: %s  RFI fraction: %s"
+                % (info.diff_weights, info.rfi_frac)
+            )
+
+    if not cfg.quiet:
+        print("Total number of profiles: %s" % archive.weights.size)
+    cleaner = SurgicalCleaner(cfg)
+    out: SurgicalOutput = cleaner.clean(archive, progress=progress)
+    res = out.result
+
+    if not cfg.quiet:
+        if res.converged:
+            print("RFI removal stops after %s loops." % res.loops)
+        else:
+            print(
+                "Cleaning was interrupted after the maximum amount of loops (%s)"
+                % cfg.max_iter
+            )
+        if out.n_bad_subints + out.n_bad_channels != 0:
+            print(
+                "Removed %s bad subintegrations and %s bad channels."
+                % (out.n_bad_subints, out.n_bad_channels)
+            )
+
+    o_name = output_name(cfg, archive, path)
+    io.save(out.cleaned, o_name)
+
+    if cfg.unload_res and out.residual is not None:
+        io.save(out.residual, residual_name(path, res.loops))
+
+    if cfg.print_zap:
+        from iterative_cleaner_tpu.utils.plotting import save_zap_plot
+
+        save_zap_plot(res.test_results, path, cfg.chanthresh, cfg.subintthresh)
+
+    if not cfg.no_log:
+        # Reference log line format (:173-176).
+        with open(os.path.join(log_dir, "clean.log"), "a") as fh:
+            fh.write(
+                "\n %s: Cleaned %s with %s, required loops=%s"
+                % (
+                    datetime.datetime.now(),
+                    path,
+                    cfg.namespace_repr(all_paths if all_paths is not None else [path]),
+                    res.loops,
+                )
+            )
+
+    if not cfg.quiet:
+        print("Cleaned archive: %s" % o_name)
+    return ArchiveReport(
+        path=path,
+        out_path=o_name,
+        loops=res.loops,
+        rfi_frac=res.rfi_frac,
+        converged=res.converged,
+    )
+
+
+def run(paths: list[str], cfg: CleanConfig, log_dir: str = ".") -> list[ArchiveReport]:
+    """Sequential batch with per-archive failure isolation.  (The sharded
+    multi-device batch lives in :mod:`.parallel.batch`.)"""
+    reports = []
+    for path in paths:
+        try:
+            reports.append(
+                process_archive(path, cfg, log_dir=log_dir, all_paths=paths))
+        except Exception as exc:  # noqa: BLE001 — isolate, report, continue
+            reports.append(ArchiveReport(path=path, out_path=None, error=str(exc)))
+            if not cfg.quiet:
+                print(f"ERROR cleaning {path}: {exc}")
+    return reports

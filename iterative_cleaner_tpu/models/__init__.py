@@ -1,0 +1,3 @@
+from iterative_cleaner_tpu.models.surgical import SurgicalCleaner, SurgicalOutput
+
+__all__ = ["SurgicalCleaner", "SurgicalOutput"]

@@ -1,0 +1,86 @@
+"""The flagship model: the surgical RFI cleaner.
+
+High-level archive-in → archive-out API over the core loop, the equivalent of
+the reference's ``clean()`` driver behaviors (iterative_cleaner.py:64-177):
+preprocessing, the iterative loop, the final weight application with the
+pscrunch output policy, the bad-parts sweep, and the residual archive.
+
+The name "surgical" comes from the algorithm's coast_guard ancestry (the
+"Surgical Scrub" cleaning strategy, reference :182).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+import numpy as np
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import CleanResult, ProgressFn, clean_cube, find_bad_parts
+from iterative_cleaner_tpu.io.base import Archive, STATE_INTENSITY
+from iterative_cleaner_tpu.ops.preprocess import preprocess, pscrunch, redisperse_cube
+
+
+@dataclass
+class SurgicalOutput:
+    cleaned: Archive               # original data, cleaned weights
+    result: CleanResult
+    residual: Archive | None       # reference --unload_res payload
+    n_bad_subints: int = 0
+    n_bad_channels: int = 0
+
+
+class SurgicalCleaner:
+    """Configured cleaner; ``clean(archive)`` runs the full pipeline."""
+
+    def __init__(self, cfg: CleanConfig | None = None) -> None:
+        self.cfg = cfg or CleanConfig()
+
+    def clean(self, archive: Archive, progress: ProgressFn | None = None) -> SurgicalOutput:
+        cfg = self.cfg
+        D, w0 = preprocess(archive)
+        result = clean_cube(D, w0, cfg, progress=progress, want_residual=cfg.unload_res)
+
+        final_w = result.weights
+        n_bs = n_bc = 0
+        # The reference only runs the sweep when a flag differs from 1
+        # (iterative_cleaner.py:155-156).
+        if cfg.bad_chan != 1 or cfg.bad_subint != 1:
+            final_w, n_bs, n_bc = find_bad_parts(final_w, cfg)
+
+        # Output polarization policy: full-pol unless -p (the reference's
+        # reload-from-disk dance at :147-149 exists only because it mutated
+        # its in-memory archive; we never mutate the input).
+        if cfg.pscrunch and archive.npol > 1:
+            out_data = pscrunch(archive.data, archive.state)[:, None]
+            out_state = STATE_INTENSITY
+        else:
+            out_data = archive.data
+            out_state = archive.state
+        cleaned = replace(
+            archive,
+            data=out_data,
+            weights=np.asarray(final_w, dtype=np.float32),
+            state=out_state,
+        )
+
+        residual = None
+        if cfg.unload_res and result.residual is not None:
+            # The residual archive lives in the original dispersed frame with
+            # the original weights (reference :103-107; SURVEY.md §3.5).
+            res_cube = redisperse_cube(archive, result.residual)
+            residual = replace(
+                archive,
+                data=np.asarray(res_cube, np.float32)[:, None],
+                weights=w0.copy(),
+                state=STATE_INTENSITY,
+                dedispersed=archive.dedispersed,
+            )
+
+        return SurgicalOutput(
+            cleaned=cleaned,
+            result=result,
+            residual=residual,
+            n_bad_subints=n_bs,
+            n_bad_channels=n_bc,
+        )

@@ -1,0 +1,41 @@
+"""Zap-plot output (reference iterative_cleaner.py:164-170).
+
+Host-side matplotlib on the fetched-back test results; import is deferred so
+the framework runs headless without matplotlib installed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def save_zap_plot(
+    test_results: np.ndarray,
+    ar_name: str,
+    chanthresh: float,
+    subintthresh: float,
+    out_path: str | None = None,
+) -> str:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.cm as cm
+    import matplotlib.pyplot as plt
+
+    if out_path is None:
+        # Reference filename: <name>_<chanthresh>_<subintthresh>.png (:169).
+        out_path = "%s_%s_%s.png" % (ar_name, chanthresh, subintthresh)
+    fig = plt.figure()
+    plt.imshow(
+        test_results.T,
+        vmin=0.999,
+        vmax=1.001,
+        aspect="auto",
+        interpolation="nearest",
+        cmap=cm.coolwarm,
+    )
+    plt.gca().invert_yaxis()
+    plt.title("%s cthresh=%s sthresh=%s" % (ar_name, chanthresh, subintthresh))
+    plt.savefig(out_path, bbox_inches="tight")
+    plt.close(fig)
+    return out_path
