@@ -1,0 +1,5 @@
+from iterative_cleaner_tpu.parallel.mesh import factor_mesh, make_mesh
+from iterative_cleaner_tpu.parallel.sharded import sharded_clean
+from iterative_cleaner_tpu.parallel.batch import clean_directory_batch
+
+__all__ = ["factor_mesh", "make_mesh", "sharded_clean", "clean_directory_batch"]

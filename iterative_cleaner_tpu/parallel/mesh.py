@@ -1,0 +1,69 @@
+"""Device-mesh construction for the cleaner's parallel axes.
+
+The reference is strictly serial (SURVEY.md §2.4); the TPU framework's
+parallelism maps onto three mesh axes:
+
+- ``dp`` — data parallel: one archive per mesh slice (the embarrassingly
+  parallel directory-batch axis, BASELINE.md config #4);
+- ``sp`` — subint sharding within an archive (the sequence/context-parallel
+  analog: per-channel medians become cross-device reductions over ICI);
+- ``tp`` — channel sharding (the tensor-parallel analog: per-subint medians
+  reduce across it).
+
+XLA GSPMD inserts the collectives (all-gathers for the sharded sorts, psums
+for the template reduction); nothing custom rides the wire.  Multi-host
+(DCN) extends the same mesh via ``jax.distributed.initialize`` — see
+``initialize_distributed``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def factor_mesh(n: int) -> tuple[int, int, int]:
+    """Split n devices into (dp, sp, tp), favoring dp (archives scale
+    embarrassingly), then sp (biggest axis: nsub), then tp."""
+    out = [1, 1, 1]
+    i = 0
+    m = n
+    # Peel smallest prime factors, assigning round-robin dp -> sp -> tp.
+    while m > 1:
+        p = next(p for p in range(2, m + 1) if m % p == 0)
+        out[i % 3] *= p
+        m //= p
+        i += 1
+    return tuple(out)
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    dp: int | None = None,
+    sp: int | None = None,
+    tp: int | None = None,
+    devices=None,
+) -> Mesh:
+    """Build a ('dp', 'sp', 'tp') mesh over the first n devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = devices[:n_devices]
+    if dp is None and sp is None and tp is None:
+        dp, sp, tp = factor_mesh(n_devices)
+    dp, sp, tp = dp or 1, sp or 1, tp or 1
+    if dp * sp * tp != n_devices:
+        raise ValueError(f"dp*sp*tp = {dp * sp * tp} != n_devices = {n_devices}")
+    grid = np.asarray(devices).reshape(dp, sp, tp)
+    return Mesh(grid, ("dp", "sp", "tp"))
+
+
+def initialize_distributed() -> None:  # pragma: no cover - needs multi-host
+    """Multi-host entry: call once per process before building the global
+    mesh; afterwards jax.devices() spans all hosts and make_mesh shards over
+    ICI within a slice and DCN across slices."""
+    jax.distributed.initialize()

@@ -1,0 +1,95 @@
+"""Sharded multi-archive execution: vmap over archives, GSPMD over the mesh.
+
+The batched clean is the single-archive kernel vmapped over a leading archive
+axis, with inputs laid out on a ('dp', 'sp', 'tp') mesh: archives over dp,
+subints over sp, channels over tp.  The cross-profile couplings are exactly
+the per-channel / per-subint median reductions (SURVEY.md §2.4 SP/CP row), so
+the sharded sorts all-gather their axis over ICI and everything else stays
+local; XLA inserts those collectives from the input shardings.
+
+Batching note: archives are bucketed by *exact* shape.  Zero-weight padding
+is NOT mask-transparent — padded profiles would still enter the mask-blind
+FFT diagnostic's plain medians (§8.L1) and change real archives' masks — so
+we never pad.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.backends.jax_backend import clean_step, fused_clean
+
+
+@partial(jax.jit, static_argnames=("pulse_region",))
+def batched_clean_step(Db, w0b, validb, w_prevb, chanthresh, subintthresh, *, pulse_region):
+    """One iteration for a batch of archives: (a, s, c, b) cubes."""
+    fn = lambda D, w0, v, w: clean_step(
+        D, w0, v, w, chanthresh, subintthresh, pulse_region=pulse_region)
+    return jax.vmap(fn)(Db, w0b, validb, w_prevb)
+
+
+@partial(jax.jit, static_argnames=("max_iter", "pulse_region"))
+def batched_fused_clean(Db, w0b, validb, chanthresh, subintthresh, *, max_iter, pulse_region):
+    """Whole convergence loop for a batch (vmapped lax.while_loop: runs until
+    every archive in the batch has converged or hit max_iter)."""
+    fn = lambda D, w0, v: fused_clean(
+        D, w0, v, chanthresh, subintthresh,
+        max_iter=max_iter, pulse_region=pulse_region)
+    return jax.vmap(fn)(Db, w0b, validb)
+
+
+def batch_spec(shape, mesh: Mesh) -> P:
+    """archives->dp, subints->sp, channels->tp, bins replicated — dropping
+    any mesh axis that does not divide its array dimension (GSPMD requires
+    even sharding; a bucket of 1 archive on a dp=2 mesh just replicates dp)."""
+    names = ("dp", "sp", "tp")
+    dims = []
+    for dim, name in zip(shape[:3], names):
+        dims.append(name if dim % mesh.shape[name] == 0 else None)
+    dims += [None] * (len(shape) - 3)
+    return P(*dims)
+
+
+def shard_batch(Db, w0b, mesh: Mesh):
+    """Lay a stacked batch out on the mesh (see batch_spec)."""
+    Db = jnp.asarray(Db)
+    w0b = jnp.asarray(w0b)
+    Db = jax.device_put(Db, NamedSharding(mesh, batch_spec(Db.shape, mesh)))
+    w0b = jax.device_put(w0b, NamedSharding(mesh, batch_spec(w0b.shape, mesh)))
+    return Db, w0b
+
+
+def sharded_clean(
+    Db: np.ndarray,
+    w0b: np.ndarray,
+    cfg: CleanConfig,
+    mesh: Mesh,
+):
+    """Clean a same-shape batch of preprocessed cubes on a device mesh.
+
+    Returns host arrays: (test (a,s,c), weights (a,s,c), loops (a,),
+    converged (a,)).
+    """
+    Db, w0b = shard_batch(Db, w0b, mesh)
+    validb = w0b != 0
+    test, w_final, loops, done, _x, _r = batched_fused_clean(
+        Db,
+        w0b,
+        validb,
+        float(cfg.chanthresh),
+        float(cfg.subintthresh),
+        max_iter=int(cfg.max_iter),
+        pulse_region=tuple(cfg.pulse_region),
+    )
+    return (
+        np.asarray(test),
+        np.asarray(w_final),
+        np.asarray(loops),
+        np.asarray(done),
+    )

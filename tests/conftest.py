@@ -7,15 +7,29 @@ jax import.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The XLA_FLAGS must be in place before the CPU backend initializes (it is
+# lazy, so this works even though the dev environment's sitecustomize has
+# already imported jax and eagerly initialized the axon TPU backend, which
+# also ignores any later JAX_PLATFORMS override).  Tests then run on the
+# virtual 8-device CPU platform; set ICT_TEST_TPU=1 to use the real chip.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+import jax
 import numpy as np
 import pytest
 
+if not os.environ.get("ICT_TEST_TPU"):
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
 from iterative_cleaner_tpu.io.synthetic import make_archive, RFISpec
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    return jax.devices("cpu")
 
 
 @pytest.fixture(scope="session")

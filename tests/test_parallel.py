@@ -1,0 +1,131 @@
+"""Sharded execution on the virtual 8-device CPU mesh (SURVEY.md §4.4)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.ops.preprocess import preprocess
+from iterative_cleaner_tpu.parallel.mesh import factor_mesh, make_mesh
+from iterative_cleaner_tpu.parallel.sharded import sharded_clean
+
+
+def _cpu():
+    return jax.devices("cpu")
+
+
+def test_eight_virtual_devices():
+    assert len(_cpu()) == 8
+
+
+@pytest.mark.parametrize(
+    "n,expect", [(1, (1, 1, 1)), (2, (2, 1, 1)), (4, (2, 2, 1)), (6, (2, 3, 1)), (8, (2, 2, 2))]
+)
+def test_factor_mesh(n, expect):
+    assert factor_mesh(n) == expect
+
+
+def test_make_mesh_axes():
+    mesh = make_mesh(8, devices=_cpu())
+    assert mesh.axis_names == ("dp", "sp", "tp")
+    assert mesh.devices.size == 8
+
+
+def test_make_mesh_explicit_mismatch():
+    with pytest.raises(ValueError):
+        make_mesh(8, dp=3, sp=1, tp=1, devices=_cpu())
+
+
+class TestShardedClean:
+    def _batch(self, n=2, seed0=20):
+        archives = [make_archive(nsub=8, nchan=16, nbin=64, seed=seed0 + i) for i in range(n)]
+        pre = [preprocess(a) for a in archives]
+        Db = np.stack([d for d, _ in pre])
+        w0b = np.stack([w for _, w in pre])
+        return Db, w0b
+
+    def test_sharded_matches_single_archive_masks(self):
+        Db, w0b = self._batch(2)
+        cfg = CleanConfig(backend="jax", max_iter=4)
+        # dp=2, sp=2, tp=2 — every axis genuinely sharded
+        mesh = make_mesh(8, devices=_cpu())
+        test_b, w_b, loops_b, done_b = sharded_clean(Db, w0b, cfg, mesh)
+        for i in range(2):
+            res = clean_cube(Db[i], w0b[i], cfg)
+            np.testing.assert_array_equal(w_b[i], res.weights)
+            assert int(loops_b[i]) == res.loops
+            assert bool(done_b[i]) == res.converged
+
+    def test_sharded_matches_numpy_oracle(self):
+        Db, w0b = self._batch(2, seed0=31)
+        mesh = make_mesh(8, devices=_cpu())
+        _t, w_b, _l, _d = sharded_clean(
+            Db, w0b, CleanConfig(backend="jax", max_iter=4), mesh)
+        for i in range(2):
+            res = clean_cube(Db[i], w0b[i], CleanConfig(backend="numpy", max_iter=4))
+            np.testing.assert_array_equal(w_b[i], res.weights)
+
+    def test_dp_only_mesh(self):
+        Db, w0b = self._batch(4, seed0=40)
+        mesh = make_mesh(4, dp=4, sp=1, tp=1, devices=_cpu())
+        _t, w_b, loops_b, _d = sharded_clean(
+            Db, w0b, CleanConfig(backend="jax", max_iter=3), mesh)
+        assert w_b.shape == (4, 8, 16)
+
+
+def test_directory_batch(tmp_path):
+    from iterative_cleaner_tpu.io.npz import NpzIO
+    from iterative_cleaner_tpu.parallel.batch import clean_directory_batch
+
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"a{i}.npz")
+        NpzIO().save(make_archive(nsub=8, nchan=16, nbin=64, seed=50 + i), p)
+        paths.append(p)
+    # a different shape lands in its own bucket
+    p_odd = str(tmp_path / "odd.npz")
+    NpzIO().save(make_archive(nsub=4, nchan=16, nbin=64, seed=99), p_odd)
+    paths.append(p_odd)
+    # and one corrupt path is isolated
+    paths.append(str(tmp_path / "missing.npz"))
+
+    items = clean_directory_batch(
+        paths, CleanConfig(backend="jax", max_iter=3),
+        mesh=make_mesh(8, devices=_cpu()))
+    assert [it.error is None for it in items] == [True, True, True, True, False]
+    for it in items[:4]:
+        assert it.weights is not None and it.loops >= 1
+    # bucketed result equals the solo run
+    res = clean_cube(*preprocess(get_archive(paths[0])), CleanConfig(backend="jax", max_iter=3))
+    np.testing.assert_array_equal(items[0].weights, res.weights)
+
+
+def get_archive(path):
+    from iterative_cleaner_tpu.io.npz import NpzIO
+
+    return NpzIO().load(path)
+
+
+def test_graft_entry_single_chip():
+    import importlib.util, pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "__graft_entry__", pathlib.Path(__file__).parent.parent / "__graft_entry__.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fn, args = mod.entry()
+    out = jax.jit(fn)(*args)
+    assert out[0].shape == args[1].shape
+
+
+def test_graft_dryrun_multichip():
+    import importlib.util, pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "__graft_entry__", pathlib.Path(__file__).parent.parent / "__graft_entry__.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.dryrun_multichip(8)
