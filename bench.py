@@ -1,0 +1,134 @@
+"""Benchmark: JAX/TPU fused clean vs the preserved numpy path.
+
+Measures per-iteration wall clock of the cleaning kernel on a LOFAR-HBA-scale
+synthetic archive (BASELINE.md config #2: 256 subint x 1024 chan x 1024 bin,
+1.07 GB f32) and verifies flag-mask parity along the way.
+
+Prints ONE JSON line on stdout:
+  {"metric": ..., "value": speedup, "unit": "x", "vs_baseline": ...}
+- value: numpy-step time / jax-per-iteration time, both on this machine
+  (the north-star metric: clean() wall-clock vs the preserved numpy path);
+- vs_baseline: value / 20.0 — fraction of the >=20x BASELINE.md target.
+
+Everything else (sizes, phase timings, parity) goes to stderr.  The one-off
+host->device cube upload is reported separately and excluded from the
+per-iteration figure (the kernel is HBM-resident by design; on this dev
+environment the chip sits behind a ~25 MB/s tunnel that a real TPU host
+never sees).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+NSUB = int(os.environ.get("BENCH_NSUB", 256))
+NCHAN = int(os.environ.get("BENCH_NCHAN", 1024))
+NBIN = int(os.environ.get("BENCH_NBIN", 1024))
+TARGET_SPEEDUP = 20.0  # BASELINE.md north star
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.backends.jax_backend import clean_step, fused_clean
+    from iterative_cleaner_tpu.backends.numpy_backend import NumpyCleaner
+    from iterative_cleaner_tpu.core.cleaner import clean_cube
+    from iterative_cleaner_tpu.io.synthetic import make_archive
+    from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind})")
+
+    # --- parity gate on a quick config (full loop, both backends) ---
+    t0 = time.time()
+    ar_small = make_archive(nsub=64, nchan=256, nbin=512, seed=42)
+    Ds, w0s = preprocess(ar_small)
+    res_np = clean_cube(Ds, w0s, CleanConfig(backend="numpy", max_iter=5))
+    res_jx = clean_cube(Ds, w0s, CleanConfig(backend="jax", max_iter=5, fused=True))
+    parity = bool(np.array_equal(res_np.weights, res_jx.weights))
+    log(f"parity gate (64x256x512): identical={parity} "
+        f"loops={res_np.loops}/{res_jx.loops} [{time.time() - t0:.1f}s]")
+
+    # --- the measured config ---
+    t0 = time.time()
+    ar = make_archive(nsub=NSUB, nchan=NCHAN, nbin=NBIN, seed=42)
+    D, w0 = preprocess(ar)
+    log(f"cube {D.shape} = {D.nbytes / 1e9:.2f} GB f32 "
+        f"[gen+preprocess {time.time() - t0:.1f}s]")
+
+    # numpy path: one step (its per-iteration cost is iteration-invariant).
+    cleaner = NumpyCleaner(D, w0, CleanConfig(backend="numpy"))
+    t0 = time.time()
+    _test_np, _w_np = cleaner.step(w0)
+    t_numpy_step = time.time() - t0
+    log(f"numpy per-iteration: {t_numpy_step:.2f}s")
+
+    # jax path: upload once, then the fused loop, timed via forced fetch
+    # (block_until_ready is unreliable on the axon tunnel platform).
+    t0 = time.time()
+    Dd = jax.device_put(jnp.asarray(D))
+    w0d = jax.device_put(jnp.asarray(w0))
+    validd = w0d != 0
+    np.asarray(jnp.sum(w0d))  # force completion
+    t_upload = time.time() - t0
+    log(f"host->device upload: {t_upload:.2f}s "
+        f"({D.nbytes / 1e6 / max(t_upload, 1e-9):.0f} MB/s)")
+
+    kw = dict(max_iter=5, pulse_region=(0.0, 0.0, 1.0))
+    t0 = time.time()
+    out = fused_clean(Dd, w0d, validd, 5.0, 5.0, **kw)
+    w_jax = np.asarray(out[1])
+    iters = int(out[4])
+    t_compile_and_run = time.time() - t0
+    log(f"fused compile+run: {t_compile_and_run:.2f}s ({iters} iterations)")
+
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        out = fused_clean(Dd, w0d, validd, 5.0, 5.0, **kw)
+        np.asarray(out[1])
+        times.append(time.time() - t0)
+    t_jax_loop = min(times)
+    t_jax_step = t_jax_loop / max(iters, 1)
+    log(f"fused warm: {t_jax_loop:.3f}s total, {t_jax_step:.3f}s/iteration")
+
+    # Parity at the measured scale: iteration 1 of both paths (the fused
+    # loop's final weights are only comparable when iters == 1, so compare a
+    # single explicit step instead — cheap on device).
+    step1 = clean_step(Dd, w0d, validd, w0d, 5.0, 5.0,
+                       pulse_region=(0.0, 0.0, 1.0))
+    big_parity = bool(np.array_equal(np.asarray(step1[1]), _w_np))
+    log(f"parity at {NSUB}x{NCHAN}x{NBIN} (iteration 1): {big_parity}")
+
+    speedup = t_numpy_step / t_jax_step
+    log(f"speedup (per iteration): {speedup:.1f}x  "
+        f"[target {TARGET_SPEEDUP:.0f}x]")
+
+    print(json.dumps({
+        "metric": f"clean_per_iter_speedup_jax_vs_numpy_{NSUB}x{NCHAN}x{NBIN}",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "vs_baseline": round(speedup / TARGET_SPEEDUP, 3),
+        "parity_small_config": parity,
+        "parity_measured_config_iter1": big_parity,
+        "numpy_step_s": round(t_numpy_step, 2),
+        "jax_step_s": round(t_jax_step, 4),
+        "upload_s": round(t_upload, 2),
+        "iterations": iters,
+        "device": f"{dev.platform}:{dev.device_kind}",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -90,6 +90,8 @@ class CleanConfig:
             ("bad_chan", self.bad_chan),
             ("bad_subint", self.bad_subint),
             ("backend", self.backend),
+            ("fused", self.fused),
+            ("x64", self.x64),
         ]
         inner = ", ".join(f"{k}={v!r}" for k, v in fields)
         return f"Namespace({inner})"

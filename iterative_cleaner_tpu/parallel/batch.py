@@ -80,6 +80,7 @@ def clean_directory_batch(
         if out is None:
             continue
         D, w0 = out
+        loaded[i] = None  # `cubes` is now the sole owner -> per-bucket release works
         cubes[i] = (D, w0)
         buckets[D.shape].append(i)
 
