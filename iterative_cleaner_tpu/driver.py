@@ -11,15 +11,12 @@ from __future__ import annotations
 
 import datetime
 import os
+import sys
 from dataclasses import dataclass
 
 from iterative_cleaner_tpu.config import CleanConfig
-from iterative_cleaner_tpu.io.base import Archive, get_io
+from iterative_cleaner_tpu.io.base import Archive, get_io, known_extension as _ext
 from iterative_cleaner_tpu.models.surgical import SurgicalCleaner, SurgicalOutput
-
-
-def _ext(path: str) -> str:
-    return ".npz" if path.endswith(".npz") else ".ar"
 
 
 def output_name(cfg: CleanConfig, archive: Archive, path: str) -> str:
@@ -142,6 +139,6 @@ def run(paths: list[str], cfg: CleanConfig, log_dir: str = ".") -> list[ArchiveR
                 process_archive(path, cfg, log_dir=log_dir, all_paths=paths))
         except Exception as exc:  # noqa: BLE001 — isolate, report, continue
             reports.append(ArchiveReport(path=path, out_path=None, error=str(exc)))
-            if not cfg.quiet:
-                print(f"ERROR cleaning {path}: {exc}")
+            # Failures are never silenced — -q only gates progress chatter.
+            print(f"ERROR cleaning {path}: {exc}", file=sys.stderr)
     return reports

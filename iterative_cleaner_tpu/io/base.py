@@ -92,12 +92,43 @@ class ArchiveIO(Protocol):
     def save(self, archive: Archive, path: str) -> None: ...
 
 
-def get_io(path: str) -> "ArchiveIO":
-    """Pick an I/O backend from the file extension."""
-    if path.endswith(".npz"):
-        from iterative_cleaner_tpu.io.npz import NpzIO
+def _npz_io():
+    from iterative_cleaner_tpu.io.npz import NpzIO
 
-        return NpzIO()
+    return NpzIO()
+
+
+def _ictb_io():
+    from iterative_cleaner_tpu.io.ictb import IctbIO
+
+    return IctbIO()
+
+
+def _psrchive_io():
     from iterative_cleaner_tpu.io.psrchive_io import PsrchiveIO
 
     return PsrchiveIO()
+
+
+# Single source of truth for extension routing — the driver derives output
+# extensions from the same table (anything unlisted is a PSRCHIVE .ar path).
+EXTENSION_IO = {
+    ".npz": _npz_io,
+    ".ictb": _ictb_io,
+}
+DEFAULT_EXT = ".ar"
+
+
+def known_extension(path: str) -> str:
+    for ext in EXTENSION_IO:
+        if path.endswith(ext):
+            return ext
+    return DEFAULT_EXT
+
+
+def get_io(path: str) -> "ArchiveIO":
+    """Pick an I/O backend from the file extension."""
+    ext = known_extension(path)
+    if ext in EXTENSION_IO:
+        return EXTENSION_IO[ext]()
+    return _psrchive_io()

@@ -1,0 +1,26 @@
+"""Native binary archive backend (.ictb) — the fast data-loader path.
+
+Flat binary layout written/read by the C++ runtime (native/ict_native.cc):
+no compression, one sequential read, threaded batch loading.  Orders of
+magnitude faster to decode than .npz for the GB-scale cubes the TPU pipeline
+streams.
+"""
+
+from __future__ import annotations
+
+from iterative_cleaner_tpu import native
+from iterative_cleaner_tpu.io.base import Archive
+
+
+class IctbIO:
+    def __init__(self) -> None:
+        if not native.available():
+            raise ImportError(
+                "native library unavailable; build it with `make -C native` "
+                "(needs g++) or use the .npz backend")
+
+    def load(self, path: str) -> Archive:
+        return native.load_ictb(path)
+
+    def save(self, archive: Archive, path: str) -> None:
+        native.save_ictb(path, archive)

@@ -1,0 +1,183 @@
+"""ctypes bindings for the native host runtime (native/ict_native.cc).
+
+Builds on demand with ``make -C native`` (g++ + OpenMP); everything degrades
+to the pure-numpy path when the toolchain or library is unavailable, so the
+framework never hard-depends on the native layer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_PKG_DIR, "_native", "libict_native.so")
+_NATIVE_SRC_DIR = os.path.join(os.path.dirname(_PKG_DIR), "native")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+STATE_TO_ENUM = {"Intensity": 0, "Stokes": 1, "Coherence": 2}
+ENUM_TO_STATE = {v: k for k, v in STATE_TO_ENUM.items()}
+
+
+class IctbHeader(ctypes.Structure):
+    _fields_ = [
+        ("magic", ctypes.c_uint32),
+        ("version", ctypes.c_uint32),
+        ("nsub", ctypes.c_uint32),
+        ("npol", ctypes.c_uint32),
+        ("nchan", ctypes.c_uint32),
+        ("nbin", ctypes.c_uint32),
+        ("centre_frequency", ctypes.c_double),
+        ("dm", ctypes.c_double),
+        ("period", ctypes.c_double),
+        ("mjd_start", ctypes.c_double),
+        ("mjd_end", ctypes.c_double),
+        ("state", ctypes.c_uint32),
+        ("dedispersed", ctypes.c_uint32),
+        ("source", ctypes.c_char * 64),
+    ]
+
+
+def _build() -> bool:
+    if not os.path.isdir(_NATIVE_SRC_DIR):
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_SRC_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_SO_PATH)
+    except Exception:  # noqa: BLE001 — missing toolchain: fall back to numpy
+        return False
+
+
+def get_lib():
+    """The loaded library, building it first if needed; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO_PATH) and not _build():
+            return None
+        lib = ctypes.CDLL(_SO_PATH)
+        u32, f32p = ctypes.c_uint32, ctypes.POINTER(ctypes.c_float)
+        f64p, i32p = ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int32)
+        hp = ctypes.POINTER(IctbHeader)
+        lib.ictb_save.argtypes = [ctypes.c_char_p, hp, f64p, f32p, f32p]
+        lib.ictb_save.restype = ctypes.c_int
+        lib.ictb_load_header.argtypes = [ctypes.c_char_p, hp]
+        lib.ictb_load_header.restype = ctypes.c_int
+        lib.ictb_load.argtypes = [ctypes.c_char_p, hp, f64p, f32p, f32p]
+        lib.ictb_load.restype = ctypes.c_int
+        lib.ict_preprocess.argtypes = [
+            f32p, f32p, i32p, u32, u32, u32, u32, u32, u32, f32p]
+        lib.ict_preprocess.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def save_ictb(path: str, archive) -> None:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable (no g++ toolchain?)")
+    h = IctbHeader(
+        nsub=archive.nsub, npol=archive.npol, nchan=archive.nchan,
+        nbin=archive.nbin, centre_frequency=archive.centre_frequency,
+        dm=archive.dm, period=archive.period, mjd_start=archive.mjd_start,
+        mjd_end=archive.mjd_end, state=STATE_TO_ENUM[archive.state],
+        dedispersed=int(archive.dedispersed),
+        source=archive.source.encode()[:63],
+    )
+    data = np.ascontiguousarray(archive.data, np.float32)
+    weights = np.ascontiguousarray(archive.weights, np.float32)
+    freqs = np.ascontiguousarray(archive.freqs, np.float64)
+    rc = lib.ictb_save(
+        path.encode(), ctypes.byref(h),
+        freqs.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        _fptr(weights), _fptr(data))
+    if rc != 0:
+        raise OSError(f"ictb_save({path}) failed with rc={rc}")
+
+
+def load_ictb(path: str):
+    from iterative_cleaner_tpu.io.base import Archive
+
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable (no g++ toolchain?)")
+    h = IctbHeader()
+    rc = lib.ictb_load_header(path.encode(), ctypes.byref(h))
+    if rc != 0:
+        raise OSError(f"ictb_load_header({path}) failed with rc={rc}")
+    freqs = np.empty(h.nchan, np.float64)
+    weights = np.empty((h.nsub, h.nchan), np.float32)
+    data = np.empty((h.nsub, h.npol, h.nchan, h.nbin), np.float32)
+    rc = lib.ictb_load(
+        path.encode(), ctypes.byref(h),
+        freqs.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        _fptr(weights), _fptr(data))
+    if rc != 0:
+        raise OSError(f"ictb_load({path}) failed with rc={rc}")
+    return Archive(
+        data=data, weights=weights, freqs=freqs,
+        centre_frequency=h.centre_frequency, dm=h.dm, period=h.period,
+        source=h.source.decode(errors="replace"),
+        mjd_start=h.mjd_start, mjd_end=h.mjd_end,
+        state=ENUM_TO_STATE[h.state], dedispersed=bool(h.dedispersed),
+        filename=path,
+    )
+
+
+def preprocess_native(archive) -> tuple[np.ndarray, np.ndarray] | None:
+    """Native pscrunch+dedisperse+baseline; None if the library is missing.
+    Bit-matches ops.preprocess.preprocess (both accumulate baselines in f64)."""
+    from iterative_cleaner_tpu.ops.preprocess import (
+        BASELINE_FRAC,
+        dispersion_shifts,
+    )
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    nsub, npol, nchan, nbin = archive.data.shape
+    # load_ictb fills a header first; ictb_load revalidates dims against it,
+    # so the buffers allocated here can never be overflowed by a file that
+    # changed on disk in between.
+    shifts = (
+        dispersion_shifts(
+            archive.freqs, archive.dm, archive.period, nbin, archive.centre_frequency
+        )
+        if not archive.dedispersed
+        else np.zeros(nchan, np.int64)
+    ).astype(np.int32)
+    width = max(1, int(round(BASELINE_FRAC * nbin)))
+    data = np.ascontiguousarray(archive.data, np.float32)
+    # Always a fresh copy: w0 is the frozen original weights (§8.L11) and
+    # must not alias archive.weights (the numpy path's astype also copies).
+    w0 = np.array(archive.weights, dtype=np.float32, copy=True)
+    out = np.empty((nsub, nchan, nbin), np.float32)
+    rc = lib.ict_preprocess(
+        _fptr(data), _fptr(w0),
+        shifts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        nsub, npol, nchan, nbin, STATE_TO_ENUM[archive.state], width, _fptr(out))
+    if rc != 0:
+        raise RuntimeError(f"ict_preprocess failed with rc={rc}")
+    return out, w0

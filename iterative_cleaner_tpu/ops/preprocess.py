@@ -94,16 +94,34 @@ def remove_baseline(cube: np.ndarray, weights: np.ndarray, frac: float = BASELIN
     total = np.einsum("sc,scb->b", weights.astype(np.float64), cube.astype(np.float64))
     start, width = baseline_window(total, frac)
     idx = (start + np.arange(width)) % nbin
-    base = cube[..., idx].mean(axis=-1, keepdims=True)
-    return (cube - base).astype(cube.dtype)
+    # f64 accumulation: the native (C++) preprocess accumulates in double, and
+    # f64 noise (2^-52) vanishes when the subtraction rounds back to f32, so
+    # both hosts produce bit-identical cubes.  The subtraction runs per
+    # subint to keep the f64 temporaries at nchan*nbin instead of tripling
+    # peak host memory at GB cube scales.
+    base = cube[..., idx].mean(axis=-1, keepdims=True, dtype=np.float64)
+    out = np.empty_like(cube, dtype=np.float32)
+    for s in range(cube.shape[0]):
+        out[s] = (cube[s].astype(np.float64) - base[s]).astype(np.float32)
+    return out
 
 
-def preprocess(archive: Archive) -> tuple[np.ndarray, np.ndarray]:
+def preprocess(archive: Archive, prefer_native: bool = True) -> tuple[np.ndarray, np.ndarray]:
     """Archive → (D, w0): the static kernel inputs.
 
     D is the pscrunched, dedispersed, baseline-removed float32 cube
     (nsub, nchan, nbin); w0 the frozen original weights (SURVEY.md §8.L11).
+
+    Uses the C++/OpenMP host runtime when built (bit-identical output,
+    verified by tests/test_native.py); falls back to the numpy path.
     """
+    if prefer_native:
+        from iterative_cleaner_tpu import native
+
+        if native.available():
+            out = native.preprocess_native(archive)
+            if out is not None:
+                return out
     cube = pscrunch(archive.data, archive.state).astype(np.float32)
     if not archive.dedispersed:
         shifts = dispersion_shifts(

@@ -1,0 +1,161 @@
+// Native host runtime for iterative_cleaner_tpu.
+//
+// Plays the role PSRCHIVE's C++ core plays for the reference (SURVEY.md
+// §2.2): archive file I/O and the iteration-invariant cube preprocessing —
+// but TPU-framework-shaped: a flat binary archive format (.ictb) built for
+// sequential-read bandwidth (batches parallelize at the Python level, one
+// thread per file), and an OpenMP preprocess (pscrunch + integer dedispersion
+// + baseline removal) producing the kernel input cube.
+//
+// Exposed as a C API consumed via ctypes (no pybind11 in this environment).
+// Build: `make -C native` -> iterative_cleaner_tpu/_native/libict_native.so
+
+#include <cstdint>
+#include <cstdio>
+#include <vector>
+
+extern "C" {
+
+// Polarization states (mirror io/base.py).
+enum IctState : uint32_t { ICT_INTENSITY = 0, ICT_STOKES = 1, ICT_COHERENCE = 2 };
+
+typedef struct {
+  uint32_t magic;    // 'ICTB' = 0x42544349 little-endian
+  uint32_t version;
+  uint32_t nsub, npol, nchan, nbin;
+  double centre_frequency, dm, period, mjd_start, mjd_end;
+  uint32_t state;
+  uint32_t dedispersed;
+  char source[64];
+} IctbHeader;
+
+static const uint32_t kMagic = 0x42544349u;
+static const uint32_t kVersion = 1u;
+
+// ---------------------------------------------------------------- file I/O
+
+int ictb_save(const char* path, const IctbHeader* h, const double* freqs,
+              const float* weights, const float* data) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return -1;
+  IctbHeader hdr = *h;
+  hdr.magic = kMagic;
+  hdr.version = kVersion;
+  size_t nprof = (size_t)hdr.nsub * hdr.nchan;
+  size_t ndata = nprof * hdr.npol * hdr.nbin;
+  int ok = fwrite(&hdr, sizeof(hdr), 1, f) == 1 &&
+           fwrite(freqs, sizeof(double), hdr.nchan, f) == hdr.nchan &&
+           fwrite(weights, sizeof(float), nprof, f) == nprof &&
+           fwrite(data, sizeof(float), ndata, f) == ndata;
+  fclose(f);
+  return ok ? 0 : -2;
+}
+
+int ictb_load_header(const char* path, IctbHeader* h) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  int ok = fread(h, sizeof(*h), 1, f) == 1;
+  fclose(f);
+  if (!ok) return -2;
+  if (h->magic != kMagic) return -3;
+  if (h->version != kVersion) return -4;
+  return 0;
+}
+
+// Caller allocates from the header dims (load_header first).  The caller's
+// header dims are re-validated against the file so a file replaced between
+// the two opens can never overflow the caller's buffers.
+int ictb_load(const char* path, IctbHeader* h, double* freqs, float* weights,
+              float* data) {
+  const IctbHeader expect = *h;
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  int rc = 0;
+  if (fread(h, sizeof(*h), 1, f) != 1) rc = -2;
+  if (!rc && h->magic != kMagic) rc = -3;
+  if (!rc && h->version != kVersion) rc = -4;
+  if (!rc && (h->nsub != expect.nsub || h->npol != expect.npol ||
+              h->nchan != expect.nchan || h->nbin != expect.nbin))
+    rc = -6;  // dims changed since load_header
+  if (!rc) {
+    size_t nprof = (size_t)h->nsub * h->nchan;
+    size_t ndata = nprof * h->npol * h->nbin;
+    if (fread(freqs, sizeof(double), h->nchan, f) != h->nchan ||
+        fread(weights, sizeof(float), nprof, f) != nprof ||
+        fread(data, sizeof(float), ndata, f) != ndata)
+      rc = -5;
+  }
+  fclose(f);
+  return rc;
+}
+
+// ------------------------------------------------------------- preprocess
+
+// Total-intensity scrunch + per-channel integer dedispersion rotation +
+// per-profile baseline removal (window found on the weighted total profile).
+// Semantics bit-match iterative_cleaner_tpu.ops.preprocess (double
+// accumulation, first-minimum window, subtract-then-round-to-f32).
+int ict_preprocess(const float* data, const float* weights,
+                   const int32_t* shifts, uint32_t nsub, uint32_t npol,
+                   uint32_t nchan, uint32_t nbin, uint32_t state,
+                   uint32_t baseline_width, float* out) {
+  const size_t prof_stride = nbin;
+  const size_t chan_stride = (size_t)npol * nchan * nbin;
+
+  // 1. pscrunch + dedisperse into `out`.
+#pragma omp parallel for collapse(2) schedule(static)
+  for (uint32_t s = 0; s < nsub; ++s) {
+    for (uint32_t c = 0; c < nchan; ++c) {
+      const float* p0 = data + (size_t)s * chan_stride + (size_t)c * nbin;
+      const float* p1 = p0 + (size_t)nchan * nbin;  // second pol, if any
+      float* o = out + ((size_t)s * nchan + c) * prof_stride;
+      int32_t sh = shifts[c] % (int32_t)nbin;
+      if (sh < 0) sh += nbin;
+      for (uint32_t b = 0; b < nbin; ++b) {
+        uint32_t src = (b + (uint32_t)sh) % nbin;  // roll(x, -sh) semantics
+        float v = p0[src];
+        if (npol > 1 && state == ICT_COHERENCE) v += p1[src];
+        o[b] = v;
+      }
+    }
+  }
+
+  // 2. Weighted total profile (double accumulation, s-then-c order to match
+  //    the sequential cumsum semantics of the host reference path).
+  std::vector<double> total(nbin, 0.0);
+  for (uint32_t s = 0; s < nsub; ++s)
+    for (uint32_t c = 0; c < nchan; ++c) {
+      const double w = weights[(size_t)s * nchan + c];
+      const float* o = out + ((size_t)s * nchan + c) * prof_stride;
+      for (uint32_t b = 0; b < nbin; ++b) total[b] += w * (double)o[b];
+    }
+
+  // 3. First-minimum circular running-mean window.
+  uint32_t width = baseline_width ? baseline_width : 1;
+  std::vector<double> ext(nbin + width);
+  for (uint32_t b = 0; b < nbin + width; ++b) ext[b] = total[b % nbin];
+  std::vector<double> csum(nbin + width + 1, 0.0);
+  for (uint32_t b = 0; b < nbin + width; ++b) csum[b + 1] = csum[b] + ext[b];
+  uint32_t start = 0;
+  double best = (csum[width] - csum[0]) / width;
+  for (uint32_t b = 1; b < nbin; ++b) {
+    double m = (csum[b + width] - csum[b]) / width;
+    if (m < best) { best = m; start = b; }
+  }
+
+  // 4. Subtract each profile's own off-pulse mean (double accumulate).
+#pragma omp parallel for collapse(2) schedule(static)
+  for (uint32_t s = 0; s < nsub; ++s) {
+    for (uint32_t c = 0; c < nchan; ++c) {
+      float* o = out + ((size_t)s * nchan + c) * prof_stride;
+      double acc = 0.0;
+      for (uint32_t k = 0; k < width; ++k) acc += (double)o[(start + k) % nbin];
+      const double mean = acc / width;
+      for (uint32_t b = 0; b < nbin; ++b)
+        o[b] = (float)((double)o[b] - mean);
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"
