@@ -71,6 +71,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "dispatch (no per-loop progress output)")
     p.add_argument("--x64", action="store_true",
                    help="jax: float64 intermediates (requires JAX_ENABLE_X64=1)")
+    p.add_argument("--sharded_batch", action="store_true",
+                   help="clean same-shape archives together, sharded over the "
+                        "device mesh (one archive per dp slice)")
+    p.add_argument("--dump_masks", action="store_true",
+                   help="save the final mask (plus per-iteration history in "
+                        "stepwise mode) as <output>_masks.npz")
+    p.add_argument("--trace", type=str, default="", metavar="DIR",
+                   help="write a jax.profiler trace to DIR")
     return p
 
 
@@ -92,6 +100,9 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         backend=args.backend,
         fused=args.fused,
         x64=args.x64,
+        sharded_batch=args.sharded_batch,
+        dump_masks=args.dump_masks,
+        trace_dir=args.trace,
     )
 
 

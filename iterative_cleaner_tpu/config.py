@@ -49,6 +49,9 @@ class CleanConfig:
     backend: str = "numpy"         # {'numpy', 'jax'}
     fused: bool = False            # jax: run the whole loop as one lax.while_loop
     x64: bool = False              # jax: use float64 intermediates for bit parity
+    sharded_batch: bool = False    # clean same-shape archives together on the mesh
+    dump_masks: bool = False       # save mask history NPZ next to the output
+    trace_dir: str = ""            # jax.profiler trace output directory
 
     def __post_init__(self) -> None:
         if self.max_iter < 1:
@@ -60,6 +63,8 @@ class CleanConfig:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.fused and self.backend != "jax":
             raise ValueError("fused=True requires backend='jax'")
+        if self.sharded_batch and self.backend != "jax":
+            raise ValueError("sharded_batch=True requires backend='jax'")
         if len(self.pulse_region) != 3:
             raise ValueError("pulse_region must have exactly 3 elements")
         object.__setattr__(self, "pulse_region", tuple(float(v) for v in self.pulse_region))
@@ -92,6 +97,7 @@ class CleanConfig:
             ("backend", self.backend),
             ("fused", self.fused),
             ("x64", self.x64),
+            ("sharded_batch", self.sharded_batch),
         ]
         inner = ", ".join(f"{k}={v!r}" for k, v in fields)
         return f"Namespace({inner})"

@@ -28,6 +28,7 @@ class IterationInfo:
     index: int                 # 1-based loop counter (reference's `x`)
     diff_weights: int          # entries changed vs previous weights
     rfi_frac: float            # zapped fraction after this iteration
+    duration_s: float = 0.0    # host wall-clock of this iteration's step
 
 
 @dataclass
@@ -92,6 +93,9 @@ def clean_cube(
     loops = cfg.max_iter
     converged = False
 
+    from iterative_cleaner_tpu.utils.tracing import StepTimer
+
+    timer = StepTimer()
     for x in range(1, cfg.max_iter + 1):
         test_results, new_w = backend.step(w_prev)
         test_results = np.asarray(test_results)
@@ -101,6 +105,7 @@ def clean_cube(
             index=x,
             diff_weights=int(np.sum(new_w != history[-1])),
             rfi_frac=float((new_w.size - np.count_nonzero(new_w)) / new_w.size),
+            duration_s=timer.lap(),
         )
         infos.append(info)
         if progress is not None:

@@ -4,7 +4,8 @@ The host orchestration layer above the model (reference ``main()`` +
 ``clean()``'s output plumbing, iterative_cleaner.py:44-61, 147-177): output
 naming modes, the residual archive, the zap plot, and the append-only
 clean.log audit trail.  One corrupt archive must not kill a batch
-(SURVEY.md §5 "failure detection"), so per-archive errors are isolated.
+(SURVEY.md §5 "failure detection"), so per-archive errors are isolated in
+both the sequential and the sharded-batch paths.
 """
 
 from __future__ import annotations
@@ -54,6 +55,73 @@ class ArchiveReport:
     error: str | None = None
 
 
+def dump_masks(
+    o_name: str, history, test_results, loops: int, converged: bool
+) -> None:
+    """Mask audit dump (SURVEY.md §5 checkpoint gap) alongside the cleaned
+    archive.  ``history`` (per-iteration masks) is only tracked by the
+    stepwise path; modes that don't track it (fused, sharded batch) omit the
+    key rather than writing an empty lie — consumers check ``"history" in
+    npz``."""
+    import numpy as np
+
+    payload = dict(test_results=test_results, loops=loops, converged=converged)
+    if history:
+        payload["history"] = np.stack(history)
+    np.savez_compressed(f"{o_name}_masks.npz", **payload)
+
+
+def emit_outputs(
+    io,
+    archive: Archive,
+    path: str,
+    cleaned: Archive,
+    test_results,
+    loops: int,
+    converged: bool,
+    rfi_frac: float,
+    cfg: CleanConfig,
+    log_dir: str,
+    all_paths: list[str],
+    history=None,
+) -> ArchiveReport:
+    """The side-output block shared by the sequential and sharded-batch
+    drivers: save, zap plot, mask dump, clean.log line, report."""
+    o_name = output_name(cfg, archive, path)
+    io.save(cleaned, o_name)
+
+    if cfg.print_zap:
+        from iterative_cleaner_tpu.utils.plotting import save_zap_plot
+
+        save_zap_plot(test_results, path, cfg.chanthresh, cfg.subintthresh)
+
+    if cfg.dump_masks:
+        dump_masks(o_name, history, test_results, loops, converged)
+
+    if not cfg.no_log:
+        # Reference log line format (:173-176).
+        with open(os.path.join(log_dir, "clean.log"), "a") as fh:
+            fh.write(
+                "\n %s: Cleaned %s with %s, required loops=%s"
+                % (
+                    datetime.datetime.now(),
+                    path,
+                    cfg.namespace_repr(all_paths),
+                    loops,
+                )
+            )
+
+    if not cfg.quiet:
+        print("Cleaned archive: %s" % o_name)
+    return ArchiveReport(
+        path=path,
+        out_path=o_name,
+        loops=loops,
+        rfi_frac=rfi_frac,
+        converged=converged,
+    )
+
+
 def process_archive(
     path: str,
     cfg: CleanConfig,
@@ -76,8 +144,11 @@ def process_archive(
 
     if not cfg.quiet:
         print("Total number of profiles: %s" % archive.weights.size)
+    from iterative_cleaner_tpu.utils.tracing import profile_trace
+
     cleaner = SurgicalCleaner(cfg)
-    out: SurgicalOutput = cleaner.clean(archive, progress=progress)
+    with profile_trace(cfg.trace_dir):
+        out: SurgicalOutput = cleaner.clean(archive, progress=progress)
     res = out.result
 
     if not cfg.quiet:
@@ -94,44 +165,73 @@ def process_archive(
                 % (out.n_bad_subints, out.n_bad_channels)
             )
 
-    o_name = output_name(cfg, archive, path)
-    io.save(out.cleaned, o_name)
-
     if cfg.unload_res and out.residual is not None:
         io.save(out.residual, residual_name(path, res.loops))
 
-    if cfg.print_zap:
-        from iterative_cleaner_tpu.utils.plotting import save_zap_plot
-
-        save_zap_plot(res.test_results, path, cfg.chanthresh, cfg.subintthresh)
-
-    if not cfg.no_log:
-        # Reference log line format (:173-176).
-        with open(os.path.join(log_dir, "clean.log"), "a") as fh:
-            fh.write(
-                "\n %s: Cleaned %s with %s, required loops=%s"
-                % (
-                    datetime.datetime.now(),
-                    path,
-                    cfg.namespace_repr(all_paths if all_paths is not None else [path]),
-                    res.loops,
-                )
-            )
-
-    if not cfg.quiet:
-        print("Cleaned archive: %s" % o_name)
-    return ArchiveReport(
-        path=path,
-        out_path=o_name,
-        loops=res.loops,
-        rfi_frac=res.rfi_frac,
-        converged=res.converged,
+    return emit_outputs(
+        io,
+        archive,
+        path,
+        out.cleaned,
+        res.test_results,
+        res.loops,
+        res.converged,
+        res.rfi_frac,
+        cfg,
+        log_dir,
+        all_paths if all_paths is not None else [path],
+        history=res.history,
     )
+
+
+def run_sharded_batch(
+    paths: list[str], cfg: CleanConfig, log_dir: str = ".", mesh=None
+) -> list[ArchiveReport]:
+    """Multi-archive cleaning on the device mesh (one dispatch per same-shape
+    bucket).  Residual archives are not produced in this mode (the fused
+    kernel does not carry them); use the sequential driver for --unload_res."""
+    from iterative_cleaner_tpu.models.surgical import apply_output_policy
+    from iterative_cleaner_tpu.parallel.batch import clean_directory_batch
+    from iterative_cleaner_tpu.utils.tracing import profile_trace
+
+    if cfg.unload_res:
+        print(
+            "warning: --unload_res is not supported with --sharded_batch; "
+            "residuals will not be written", file=sys.stderr)
+    with profile_trace(cfg.trace_dir):
+        items = clean_directory_batch(paths, cfg, mesh=mesh)
+    reports = []
+    for item in items:
+        if item.error is None:
+            try:
+                cleaned = apply_output_policy(item.archive, item.weights, cfg)
+                reports.append(emit_outputs(
+                    get_io(item.path),
+                    item.archive,
+                    item.path,
+                    cleaned,
+                    item.test_results,
+                    item.loops,
+                    item.converged,
+                    item.rfi_frac,
+                    cfg,
+                    log_dir,
+                    paths,
+                ))
+                continue
+            except Exception as exc:  # noqa: BLE001 — isolate, report, continue
+                item.error = str(exc)
+        print(f"ERROR cleaning {item.path}: {item.error}", file=sys.stderr)
+        reports.append(
+            ArchiveReport(path=item.path, out_path=None, error=item.error))
+    return reports
 
 
 def run(paths: list[str], cfg: CleanConfig, log_dir: str = ".") -> list[ArchiveReport]:
     """Sequential batch with per-archive failure isolation.  (The sharded
     multi-device batch lives in :mod:`.parallel.batch`.)"""
+    if cfg.sharded_batch:
+        return run_sharded_batch(paths, cfg, log_dir=log_dir)
     reports = []
     for path in paths:
         try:

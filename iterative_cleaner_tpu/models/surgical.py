@@ -30,6 +30,24 @@ class SurgicalOutput:
     n_bad_channels: int = 0
 
 
+def apply_output_policy(archive: Archive, weights: np.ndarray, cfg: CleanConfig) -> Archive:
+    """Cleaned output archive: original amplitudes + new weights; full-pol
+    unless -p (the reference's reload-from-disk dance at :147-149 exists only
+    because it mutated its in-memory archive; we never mutate the input)."""
+    if cfg.pscrunch and archive.npol > 1:
+        out_data = pscrunch(archive.data, archive.state)[:, None]
+        out_state = STATE_INTENSITY
+    else:
+        out_data = archive.data
+        out_state = archive.state
+    return replace(
+        archive,
+        data=out_data,
+        weights=np.asarray(weights, dtype=np.float32),
+        state=out_state,
+    )
+
+
 class SurgicalCleaner:
     """Configured cleaner; ``clean(archive)`` runs the full pipeline."""
 
@@ -48,21 +66,7 @@ class SurgicalCleaner:
         if cfg.bad_chan != 1 or cfg.bad_subint != 1:
             final_w, n_bs, n_bc = find_bad_parts(final_w, cfg)
 
-        # Output polarization policy: full-pol unless -p (the reference's
-        # reload-from-disk dance at :147-149 exists only because it mutated
-        # its in-memory archive; we never mutate the input).
-        if cfg.pscrunch and archive.npol > 1:
-            out_data = pscrunch(archive.data, archive.state)[:, None]
-            out_state = STATE_INTENSITY
-        else:
-            out_data = archive.data
-            out_state = archive.state
-        cleaned = replace(
-            archive,
-            data=out_data,
-            weights=np.asarray(final_w, dtype=np.float32),
-            state=out_state,
-        )
+        cleaned = apply_output_policy(archive, final_w, cfg)
 
         residual = None
         if cfg.unload_res and result.residual is not None:

@@ -31,6 +31,7 @@ class BatchItem:
     path: str
     archive: Archive | None = None
     weights: np.ndarray | None = None   # final cleaned weights
+    test_results: np.ndarray | None = None
     loops: int = 0
     converged: bool = False
     rfi_frac: float = 0.0
@@ -99,6 +100,7 @@ def clean_directory_batch(
             if cfg.bad_chan != 1 or cfg.bad_subint != 1:
                 final_w, _ns, _nc = find_bad_parts(final_w, cfg)
             item.weights = final_w
+            item.test_results = test_b[j]
             item.loops = int(loops_b[j])
             item.converged = bool(done_b[j])
     return items

@@ -65,6 +65,14 @@ def shard_batch(Db, w0b, mesh: Mesh):
     return Db, w0b
 
 
+def sharded_clean_single(D: np.ndarray, w0: np.ndarray, cfg: CleanConfig, mesh: Mesh):
+    """One archive sharded over (sp, tp) — the path for cubes that exceed a
+    single chip's HBM (BASELINE.md config #5: the 17 GB stress cube needs
+    nsub-sharding on v5e).  Returns (test, weights, loops, converged)."""
+    test, w, loops, done = sharded_clean(D[None], w0[None], cfg, mesh)
+    return test[0], w[0], int(loops[0]), bool(done[0])
+
+
 def sharded_clean(
     Db: np.ndarray,
     w0b: np.ndarray,

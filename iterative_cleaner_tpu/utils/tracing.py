@@ -1,0 +1,35 @@
+"""Profiling / tracing hooks (SURVEY.md §5: the reference has none; the TPU
+framework exposes jax.profiler traces plus per-iteration host timings)."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+@contextlib.contextmanager
+def profile_trace(trace_dir: str | None):
+    """jax.profiler trace around a block when trace_dir is set (view with
+    tensorboard or xprof); no-op otherwise."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+class StepTimer:
+    """Wall-clock per iteration, reported through the progress callback."""
+
+    def __init__(self) -> None:
+        self._t0 = time.time()
+        self.durations: list[float] = []
+
+    def lap(self) -> float:
+        now = time.time()
+        dt = now - self._t0
+        self._t0 = now
+        self.durations.append(dt)
+        return dt

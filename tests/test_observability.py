@@ -1,0 +1,165 @@
+"""Observability + batch-CLI features: mask dumps, traces, per-iteration
+timing, the sharded-batch driver mode, and the x64 parity path."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.cli import main
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube
+from iterative_cleaner_tpu.io.npz import NpzIO
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+
+@pytest.fixture()
+def three_npz(tmp_path):
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"b{i}.npz")
+        NpzIO().save(make_archive(nsub=8, nchan=16, nbin=64, seed=60 + i), p)
+        paths.append(p)
+    return paths
+
+
+def test_iteration_durations_recorded(small_archive):
+    D, w0 = preprocess(small_archive)
+    res = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=3))
+    assert all(i.duration_s > 0 for i in res.iterations)
+
+
+def test_dump_masks(three_npz, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    rc = main(["--backend", "numpy", "-q", "-l", "--dump_masks", three_npz[0]])
+    assert rc == 0
+    dump = three_npz[0] + "_cleaned.npz_masks.npz"
+    assert os.path.exists(dump)
+    with np.load(dump) as z:
+        assert z["history"].ndim == 3  # (iters+1, nsub, nchan)
+        assert z["history"].shape[1:] == (8, 16)
+        assert z["test_results"].shape == (8, 16)
+        assert int(z["loops"]) >= 1
+
+
+def test_trace_dir_written(three_npz, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    trace_dir = str(tmp_path / "trace")
+    rc = main(["--backend", "jax", "-q", "-l", "--trace", trace_dir, three_npz[0]])
+    assert rc == 0
+    assert os.path.isdir(trace_dir) and len(os.listdir(trace_dir)) > 0
+
+
+def test_sharded_batch_cli(three_npz, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    rc = main(["--backend", "jax", "--sharded_batch", "-q", three_npz[0], three_npz[1]])
+    assert rc == 0
+    for p in three_npz[:2]:
+        out = p + "_cleaned.npz"
+        assert os.path.exists(out)
+        # batched result equals the sequential jax run
+        ar = NpzIO().load(p)
+        D, w0 = preprocess(ar)
+        res = clean_cube(D, w0, CleanConfig(backend="jax", max_iter=5))
+        np.testing.assert_array_equal(NpzIO().load(out).weights, res.weights)
+    log = (tmp_path / "clean.log").read_text()
+    assert log.count("Cleaned") == 2
+
+
+def test_sharded_batch_dump_masks_omits_history(three_npz, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    rc = main(["--sharded_batch", "--backend", "jax", "-q", "-l",
+               "--dump_masks", three_npz[0]])
+    assert rc == 0
+    with np.load(three_npz[0] + "_cleaned.npz_masks.npz") as z:
+        assert "history" not in z  # fused path tracks no history: no empty lie
+        assert z["test_results"].shape == (8, 16)
+
+
+def test_sharded_batch_save_failure_isolated(three_npz, tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    from iterative_cleaner_tpu.driver import run
+
+    # Unwritable output for archive 0 only; archive 1 must still be cleaned.
+    cfg = CleanConfig(backend="jax", sharded_batch=True, quiet=True, no_log=True,
+                      output="")
+    # A directory squatting on the output name makes the save raise
+    # (permission bits don't stop a root test runner).
+    p_bad = three_npz[2]
+    os.makedirs(p_bad + "_cleaned.npz", exist_ok=True)
+    reports = run([p_bad, three_npz[1]], cfg)
+    assert reports[0].error is not None
+    assert reports[1].error is None and os.path.exists(reports[1].out_path)
+
+
+def test_sharded_batch_requires_jax():
+    with pytest.raises(ValueError):
+        CleanConfig(backend="numpy", sharded_batch=True)
+
+
+def test_sharded_batch_cli_usage_error(capsys):
+    rc = main(["--backend", "numpy", "--sharded_batch", "x.npz"])
+    assert rc == 2
+    assert "sharded_batch" in capsys.readouterr().err
+
+
+def test_sharded_clean_single_matches_oracle():
+    import jax
+
+    from iterative_cleaner_tpu.parallel.mesh import make_mesh
+    from iterative_cleaner_tpu.parallel.sharded import sharded_clean_single
+
+    ar = make_archive(nsub=8, nchan=16, nbin=64, seed=77)
+    D, w0 = preprocess(ar)
+    # sp-heavy mesh: the single cube genuinely shards over subints+channels
+    mesh = make_mesh(8, dp=1, sp=4, tp=2, devices=jax.devices("cpu"))
+    _t, w, loops, done = sharded_clean_single(
+        D, w0, CleanConfig(backend="jax", max_iter=4), mesh)
+    res = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=4))
+    np.testing.assert_array_equal(w, res.weights)
+    assert loops == res.loops and done == res.converged
+
+
+def test_x64_mode_subprocess(tmp_path):
+    """x64 parity path: enabled via env in a fresh interpreter (the flag
+    refuses to flip process-global state itself)."""
+    script = r"""
+import numpy as np
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.ops.preprocess import preprocess
+ar = make_archive(nsub=6, nchan=16, nbin=64, seed=5)
+D, w0 = preprocess(ar)
+res64 = clean_cube(D, w0, CleanConfig(backend="jax", max_iter=4, x64=True))
+resnp = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=4))
+assert np.array_equal(res64.weights, resnp.weights), "x64 mask mismatch"
+print("X64-OK")
+"""
+    env = dict(os.environ)
+    # Drop the dev environment's TPU plugin hooks: its sitecustomize (on
+    # PYTHONPATH) eagerly grabs the axon backend regardless of JAX_PLATFORMS.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({
+        "JAX_ENABLE_X64": "1",
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    })
+    out = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True, text=True,
+        timeout=300)
+    assert "X64-OK" in out.stdout, out.stderr
+
+
+def test_x64_without_enable_raises():
+    import jax
+
+    if jax.config.jax_enable_x64:
+        pytest.skip("x64 already enabled in this process")
+    D = np.zeros((2, 2, 8), np.float32)
+    w0 = np.ones((2, 2), np.float32)
+    with pytest.raises(RuntimeError, match="JAX_ENABLE_X64"):
+        clean_cube(D, w0, CleanConfig(backend="jax", x64=True))
