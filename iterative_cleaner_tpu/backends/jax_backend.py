@@ -27,33 +27,66 @@ import jax.numpy as jnp
 import numpy as np
 
 from iterative_cleaner_tpu.config import CleanConfig
-from iterative_cleaner_tpu.ops.stats import comprehensive_stats
+from iterative_cleaner_tpu.ops.stats import (
+    comprehensive_stats,
+    comprehensive_stats_from_moments,
+)
 from iterative_cleaner_tpu.ops.template import build_template, fit_and_subtract
 
 
-@partial(jax.jit, static_argnames=("pulse_region",))
-def clean_step(D, w0, valid, w_prev, chanthresh, subintthresh, *, pulse_region):
+@partial(jax.jit, static_argnames=("pulse_region", "use_pallas"))
+def clean_step(D, w0, valid, w_prev, chanthresh, subintthresh, *, pulse_region,
+               use_pallas=False):
     """One cleaning iteration as a pure function (jit-compiled once).
 
     w_prev shapes the template (previous iteration's zaps); the stats always
     run against the frozen original weights w0 (§8.L11).  The thresholds are
     traced scalars — a threshold sweep reuses one compilation; only
     pulse_region (trace-time slicing) and shapes are static.
+
+    use_pallas routes the fit/subtract/weight/centre/moments through the
+    fused Pallas kernel (one HBM pass over the cube instead of ~5 — see
+    ops/pallas_kernels.py); it does not materialise the residual, so the
+    stepwise --unload_res path keeps the XLA route.
     """
     template = build_template(D, w_prev)
-    _amp, resid = fit_and_subtract(D, template, pulse_region)
-    weighted = resid * w0[..., None]
-    test = comprehensive_stats(weighted, valid, chanthresh, subintthresh)
+    if use_pallas:
+        from iterative_cleaner_tpu.ops.pallas_kernels import (
+            fused_fit_moments,
+            pallas_route_ok,
+            use_interpret,
+        )
+
+        if not pallas_route_ok(D.shape[-1]):
+            import warnings
+
+            warnings.warn(
+                "pallas=True but the Pallas route is not viable here "
+                "(non-TPU platform or nbin too large for VMEM); using the "
+                "XLA route", stacklevel=2)
+            use_pallas = False
+    if use_pallas:
+        centred, mean, std, ptp = fused_fit_moments(
+            D, template, w0, pulse_region=pulse_region,
+            interpret=use_interpret())
+        test = comprehensive_stats_from_moments(
+            centred, mean, std, ptp, valid, chanthresh, subintthresh)
+        resid = None
+    else:
+        _amp, resid = fit_and_subtract(D, template, pulse_region)
+        weighted = resid * w0[..., None]
+        test = comprehensive_stats(weighted, valid, chanthresh, subintthresh)
     # set_weights_archive on an original-weights clone: zap where test >= 1;
     # NaN >= 1 is False -> never flags (§8.L3).
     new_w = jnp.where(test >= 1.0, 0.0, w0)
     return test, new_w, resid
 
 
-@partial(jax.jit, static_argnames=("max_iter", "pulse_region", "want_residual"))
+@partial(jax.jit, static_argnames=(
+    "max_iter", "pulse_region", "want_residual", "use_pallas"))
 def fused_clean(
     D, w0, valid, chanthresh, subintthresh, *, max_iter, pulse_region,
-    want_residual=False,
+    want_residual=False, use_pallas=False,
 ):
     """The whole convergence loop on device (lax.while_loop).
 
@@ -63,6 +96,10 @@ def fused_clean(
     D-sized residual buffer is only carried when want_residual is set, so the
     benchmark configuration does not pay a second cube of HBM.
     """
+    if want_residual and use_pallas:
+        raise ValueError("the Pallas-fused path does not materialise the "
+                         "residual cube; use_pallas requires "
+                         "want_residual=False")
     nsub, nchan = w0.shape
     history0 = jnp.zeros((max_iter + 1, nsub, nchan), w0.dtype).at[0].set(w0)
 
@@ -73,7 +110,7 @@ def fused_clean(
         x, w_prev, history = carry[0] + 1, carry[1], carry[2]
         test, new_w, resid = clean_step(
             D, w0, valid, w_prev, chanthresh, subintthresh,
-            pulse_region=pulse_region,
+            pulse_region=pulse_region, use_pallas=use_pallas,
         )
         row_live = jnp.arange(max_iter + 1) < x  # rows 0..x-1 are populated
         hit = jnp.any(row_live & jnp.all(new_w[None] == history, axis=(1, 2)))
@@ -132,6 +169,7 @@ class JaxCleaner:
             float(self.cfg.chanthresh),
             float(self.cfg.subintthresh),
             pulse_region=tuple(self.cfg.pulse_region),
+            use_pallas=self.cfg.pallas,
         )
         self._residual = resid  # stays on device unless fetched
         return np.asarray(test), np.asarray(new_w)
@@ -156,6 +194,7 @@ def run_fused(D, w0, cfg: CleanConfig, want_residual: bool = False):
         max_iter=int(cfg.max_iter),
         pulse_region=tuple(cfg.pulse_region),
         want_residual=want_residual,
+        use_pallas=cfg.pallas and not want_residual,
     )
     out = (
         np.asarray(test),

@@ -69,6 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fused", action="store_true",
                    help="jax: run the whole iteration loop as one device "
                         "dispatch (no per-loop progress output)")
+    p.add_argument("--pallas", action="store_true",
+                   help="jax: use the fused Pallas TPU kernel for the "
+                        "fit+moments hot path (one HBM pass over the cube; "
+                        "incompatible with --unload_res)")
     p.add_argument("--x64", action="store_true",
                    help="jax: float64 intermediates (requires JAX_ENABLE_X64=1)")
     p.add_argument("--sharded_batch", action="store_true",
@@ -99,6 +103,7 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         no_log=args.no_log,
         backend=args.backend,
         fused=args.fused,
+        pallas=args.pallas,
         x64=args.x64,
         sharded_batch=args.sharded_batch,
         dump_masks=args.dump_masks,

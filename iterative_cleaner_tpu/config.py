@@ -24,6 +24,20 @@ def pulse_region_active(pulse_region) -> bool:
     return tuple(float(v) for v in pulse_region) != (0.0, 0.0, 1.0)
 
 
+def pulse_region_bin_scale(nbin: int, pulse_region, dtype="float32"):
+    """Static per-bin residual scale implementing the reference's
+    ``err2[int(start):int(end)] *= scale`` with its true argument order
+    [scale, start, end] (§8.L5).  Built with a real Python slice so negative
+    / out-of-range indices behave exactly like the reference; shared by the
+    XLA and Pallas paths so their semantics can never drift."""
+    import numpy as np
+
+    scale, start, end = pulse_region
+    bin_scale = np.ones(nbin, dtype=dtype)
+    bin_scale[int(start):int(end)] = scale
+    return bin_scale
+
+
 @dataclass(frozen=True)
 class CleanConfig:
     # --- algorithm parameters (reference flags) ---
@@ -48,6 +62,7 @@ class CleanConfig:
     # --- TPU framework extensions ---
     backend: str = "numpy"         # {'numpy', 'jax'}
     fused: bool = False            # jax: run the whole loop as one lax.while_loop
+    pallas: bool = False           # jax: fused Pallas kernel for fit+moments
     x64: bool = False              # jax: use float64 intermediates for bit parity
     sharded_batch: bool = False    # clean same-shape archives together on the mesh
     dump_masks: bool = False       # save mask history NPZ next to the output
@@ -63,6 +78,25 @@ class CleanConfig:
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.fused and self.backend != "jax":
             raise ValueError("fused=True requires backend='jax'")
+        if self.pallas and self.backend != "jax":
+            raise ValueError("pallas=True requires backend='jax'")
+        if self.pallas and self.unload_res:
+            # The Pallas kernel never materialises the residual cube (that is
+            # its point); the residual archive needs the XLA route.
+            raise ValueError("pallas=True cannot produce the residual "
+                             "archive; drop --unload_res or --pallas")
+        if self.pallas and self.x64:
+            # Mosaic has no f64, and x64's bit-parity promise is about
+            # matching numpy's reduction order, which the kernel's tiled
+            # reductions cannot guarantee anyway.
+            raise ValueError("pallas=True does not support x64=True "
+                             "(no float64 on the TPU Pallas path)")
+        if self.pallas and self.sharded_batch:
+            # vmap-under-GSPMD of pallas_call is not wired up; rejecting
+            # beats silently running the batch on the XLA route while
+            # clean.log records pallas=True.
+            raise ValueError("pallas=True is not supported with "
+                             "sharded_batch=True yet; drop one of them")
         if self.sharded_batch and self.backend != "jax":
             raise ValueError("sharded_batch=True requires backend='jax'")
         if len(self.pulse_region) != 3:
@@ -96,6 +130,7 @@ class CleanConfig:
             ("bad_subint", self.bad_subint),
             ("backend", self.backend),
             ("fused", self.fused),
+            ("pallas", self.pallas),
             ("x64", self.x64),
             ("sharded_batch", self.sharded_batch),
         ]

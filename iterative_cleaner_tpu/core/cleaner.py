@@ -83,6 +83,10 @@ def clean_cube(
             residual=out[5] if want_residual else None,
         )
 
+    if want_residual and cfg.pallas:
+        # The Pallas kernel does not materialise the residual; fall back to
+        # the XLA route for this request, exactly as run_fused does.
+        cfg = cfg.replace(pallas=False)
     backend = make_backend(D, w0, cfg)
     w0 = np.asarray(w0, dtype=np.float32)
 

@@ -1,0 +1,211 @@
+"""Pallas TPU kernels for the hot per-iteration ops (SURVEY.md §7.M5).
+
+The fused kernel below covers hot loop #1 plus the moment diagnostics of hot
+loop #2 (reference ``iterative_cleaner.py:258-287`` and ``:205-208``) in ONE
+pass over the cube's HBM: per (subint, channel) profile it computes the
+closed-form template amplitude ``amp = <t, p> / <t, t>`` (§8.L7), the
+pulse-region-scaled residual ``amp·t − p`` (:276, :279-282), the weight
+pre-scaling (:290-296), and the mean / std / ptp diagnostics (:205-208),
+emitting only the *centred* weighted residual (which the XLA FFT diagnostic
+consumes) and three (nsub, nchan) moment maps.
+
+Why this is the right fusion: the un-fused XLA path materialises the residual
+cube, the weighted cube, and the centred cube in HBM — ~5 cube-sized HBM
+transfers per iteration.  This kernel reads D once and writes one cube; the
+VPU does all the per-profile math while each block sits in VMEM.  The FFT
+diagnostic stays in XLA (TPU FFT is an XLA primitive; Pallas has none), as do
+the sort-based robust scalers (nsub×nchan maps — three orders of magnitude
+smaller than the cube, not worth kernel treatment until profiles say so).
+
+Semantics match ``ops.template.fit_and_subtract`` + the moment part of
+``ops.stats.diagnostics`` bit-for-bit up to f32 reduction order; parity is
+pinned by ``tests/test_pallas.py`` (interpret mode on CPU, compiled on TPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from iterative_cleaner_tpu.config import (
+    pulse_region_active,
+    pulse_region_bin_scale,
+)
+
+_PREC = jax.lax.Precision.HIGHEST
+
+# f32 min tile is (8, 128) on the last two dims.  The cube block's tiled dims
+# are (BC, NB), so BC only needs sublane (8) alignment; the tiny 2D moment
+# blocks tolerate a sub-128 lane via padding.  The budget keeps the block
+# around 1 MB f32 — the kernel body holds ~6 block-sized temporaries on the
+# Mosaic stack, and that plus pipeline double-buffering must fit 16 MB VMEM.
+_SUBLANE = 8
+_LANE = 128
+_BLOCK_BUDGET = 1 << 18  # profiles*bins per block ≈ 1 MB f32
+
+
+def _block_shape(nb_p: int) -> tuple[int, int]:
+    """Pick the (BS, BC) profile tile for a padded bin count."""
+    bs = _SUBLANE
+    bc = (_BLOCK_BUDGET // (bs * nb_p)) // _SUBLANE * _SUBLANE
+    return bs, max(bc, _SUBLANE)
+
+
+def _fused_kernel(tt_ref, D_ref, t_ref, bs_ref, w_ref,
+                  centred_ref, mean_ref, std_ref, ptp_ref,
+                  *, nbin: int, nb_p: int):
+    """One (BS, BC, NB) block: fit, subtract, weight, centre, moments."""
+    # The (nsub, nchan) maps travel as (BS, BC, 1) blocks: Pallas TPU wants
+    # the last two block dims (8, 128)-tiled OR equal to the array dims, and
+    # a (BS, BC) block with the VMEM-budget-sized BC < 128 satisfies neither.
+    D = D_ref[:]                      # (BS, BC, NB) f32
+    t = t_ref[:]                      # (1, NB)
+    bscale = bs_ref[:]                # (1, NB)
+    w = w_ref[:, :, 0]                # (BS, BC)
+    tt = tt_ref[0]
+
+    # Closed-form amplitude (§8.L7); leastsq on a flat objective returns its
+    # initial guess 1.0 — replicated for tt == 0 / non-finite tt.
+    tp = jnp.sum(D * t[None, :, :], axis=-1)              # (BS, BC)
+    ok = (tt != 0) & jnp.isfinite(tt)
+    amp = jnp.where(ok, tp / jnp.where(ok, tt, 1.0), 1.0)
+
+    # Residual (model − data, :276), pulse-region scale (:279-282), weight
+    # pre-scaling (:290-296) — all elementwise on the VPU.
+    wr = (amp[..., None] * t[None, :, :] - D) * bscale[None, :, :] * w[..., None]
+
+    if nbin == nb_p:
+        live = None
+        mean = jnp.sum(wr, axis=-1) / nbin
+    else:
+        # Ragged nbin: bins >= nbin are zero padding the wrapper added; they
+        # must not contaminate mean/std/ptp.
+        live = jax.lax.broadcasted_iota(jnp.int32, (1, 1, nb_p), 2) < nbin
+        wr = jnp.where(live, wr, 0.0)
+        mean = jnp.sum(wr, axis=-1) / nbin
+
+    c = wr - mean[..., None]
+    if live is None:
+        var = jnp.sum(c * c, axis=-1) / nbin
+        ptp = jnp.max(wr, axis=-1) - jnp.min(wr, axis=-1)
+    else:
+        var = jnp.sum(jnp.where(live, c * c, 0.0), axis=-1) / nbin
+        ptp = (jnp.max(jnp.where(live, wr, -jnp.inf), axis=-1)
+               - jnp.min(jnp.where(live, wr, jnp.inf), axis=-1))
+
+    centred_ref[:] = c
+    mean_ref[:] = mean[..., None]
+    std_ref[:] = jnp.sqrt(var)[..., None]
+    ptp_ref[:] = ptp[..., None]
+
+
+@functools.partial(jax.jit, static_argnames=("pulse_region", "interpret"))
+def fused_fit_moments(D, template, w0, *, pulse_region=(0.0, 0.0, 1.0),
+                      interpret=False):
+    """Fit + subtract + weight + centre + moment diagnostics, one HBM pass.
+
+    D: (nsub, nchan, nbin) f32; template: (nbin,); w0: (nsub, nchan).
+    Returns (centred, mean, std, ptp): the centred weighted-residual cube
+    (input to the mask-blind FFT diagnostic, §8.L1) and the three raw moment
+    maps (pre valid-fill — ``ops.stats.diagnostics`` fill semantics are
+    applied by the caller).
+    """
+    nsub, nchan, nbin = D.shape
+    dtype = D.dtype
+
+    # <t, t> at the same precision as the pure-XLA path (ops/template.py).
+    tt = jnp.einsum("b,b->", template, template, precision=_PREC)
+
+    # Static pulse-region bin scale (shared helper, §8.L5).
+    if pulse_region_active(pulse_region):
+        bin_scale = pulse_region_bin_scale(nbin, pulse_region)
+    else:
+        bin_scale = np.ones(nbin, dtype=np.float32)
+
+    # Pad every dim to tile multiples; padded profiles/bins are zero and are
+    # sliced away below (per-profile math — no cross-contamination).
+    nb_p = -(-nbin // _LANE) * _LANE
+    bs, bc = _block_shape(nb_p)
+    nsub_p = -(-nsub // bs) * bs
+    nchan_p = -(-nchan // bc) * bc
+
+    Dp = jnp.pad(D, ((0, nsub_p - nsub), (0, nchan_p - nchan),
+                     (0, nb_p - nbin)))
+    tp_ = jnp.pad(template.astype(dtype), (0, nb_p - nbin))[None, :]
+    bsc = jnp.pad(jnp.asarray(bin_scale, dtype), (0, nb_p - nbin))[None, :]
+    wp = jnp.pad(w0.astype(dtype), ((0, nsub_p - nsub), (0, nchan_p - nchan)))
+
+    grid = (nsub_p // bs, nchan_p // bc)
+    prof_spec = pl.BlockSpec((bs, bc, 1), lambda i, j: (i, j, 0),
+                             memory_space=pltpu.VMEM)
+    cube_spec = pl.BlockSpec((bs, bc, nb_p), lambda i, j: (i, j, 0),
+                             memory_space=pltpu.VMEM)
+    bin_spec = pl.BlockSpec((1, nb_p), lambda i, j: (0, 0),
+                            memory_space=pltpu.VMEM)
+
+    centred, mean, std, ptp = pl.pallas_call(
+        functools.partial(_fused_kernel, nbin=nbin, nb_p=nb_p),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # tt (1,)
+            cube_spec,                                # D
+            bin_spec,                                 # template
+            bin_spec,                                 # bin_scale
+            prof_spec,                                # w0 (S, C, 1)
+        ],
+        out_specs=[cube_spec, prof_spec, prof_spec, prof_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((nsub_p, nchan_p, nb_p), dtype),
+            jax.ShapeDtypeStruct((nsub_p, nchan_p, 1), dtype),
+            jax.ShapeDtypeStruct((nsub_p, nchan_p, 1), dtype),
+            jax.ShapeDtypeStruct((nsub_p, nchan_p, 1), dtype),
+        ],
+        interpret=interpret,
+    )(tt.reshape(1), Dp, tp_, bsc, wp[..., None])
+
+    return (centred[:nsub, :nchan, :nbin], mean[:nsub, :nchan, 0],
+            std[:nsub, :nchan, 0], ptp[:nsub, :nchan, 0])
+
+
+def _platform() -> str:
+    """The platform computations actually land on: ``jax_default_device``
+    wins over ``default_backend()`` — the dev/test harness pins computation
+    to the virtual CPU platform that way while an eagerly-initialised TPU
+    backend still claims ``default_backend()``.  The config value may be a
+    Device or a platform string (both supported by JAX)."""
+    dev = jax.config.jax_default_device
+    if dev is None:
+        return jax.default_backend()
+    return dev if isinstance(dev, str) else dev.platform
+
+
+def use_interpret() -> bool:
+    """Pallas TPU kernels run interpreted off-TPU (the CPU test harness)."""
+    return _platform() != "tpu"
+
+
+def pallas_route_ok(nbin: int) -> bool:
+    """Whether the Pallas route should be taken at all (trace-time check).
+
+    - TPU: yes, provided the minimum block fits the VMEM budget (the bin
+      axis is never tiled, so a huge nbin can make even a (8, 8, nb_p) block
+      blow the ~16 MB VMEM with its temporaries).
+    - CPU: yes — interpret mode, the test harness for the kernel body.
+    - anything else (GPU): no — interpret mode there would be a silent
+      orders-of-magnitude slowdown, not an optimisation.
+    """
+    platform = _platform()
+    if platform == "cpu":
+        return True
+    if platform != "tpu":
+        return False
+    nb_p = -(-nbin // _LANE) * _LANE
+    bs, bc = _block_shape(nb_p)
+    # The floored minimum block must still respect the budget the kernel's
+    # VMEM accounting was sized for (nbin <= 4096 in practice).
+    return bs * bc * nb_p <= _BLOCK_BUDGET

@@ -53,13 +53,29 @@ def diagnostics(weighted: jnp.ndarray, valid: jnp.ndarray):
     # Mask-blind FFT diagnostic (§8.L1): masked profiles were pre-zeroed by
     # the weight scaling, and the masked mean's raw data is 0.0, so they
     # contribute exactly |rfft(0)| = 0.
-    fft_mag = jnp.abs(jnp.fft.rfft(centred, axis=-1))
-    fft_diag = jnp.max(fft_mag, axis=-1)
-
-    d_std = jnp.where(valid, std, 0.0)
-    d_mean = jnp.where(valid, mean, 0.0)
-    d_ptp = jnp.where(valid, ptp, MA_FILL)
+    fft_diag = fft_diagnostic(centred)
+    d_mean, d_std, d_ptp = fill_moments(mean, std, ptp, valid)
     return d_std, d_mean, d_ptp, fft_diag
+
+
+def fill_moments(mean, std, ptp, valid):
+    """numpy.ma raw-data fills at fully-masked profiles: 0.0 for std/mean
+    (masked reductions), 1e20 for ptp (the MaskedArray fill value).
+    Returns in argument order: (mean, std, ptp)."""
+    return (jnp.where(valid, mean, 0.0), jnp.where(valid, std, 0.0),
+            jnp.where(valid, ptp, MA_FILL))
+
+
+def comprehensive_stats_from_moments(
+    centred, mean, std, ptp, valid, chanthresh: float, subintthresh: float
+) -> jnp.ndarray:
+    """The stats tail for the Pallas-fused path: the kernel already produced
+    the centred cube and raw moments (ops/pallas_kernels.py); only the XLA
+    FFT diagnostic, the fills, and the robust scalers remain."""
+    d_mean, d_std, d_ptp = fill_moments(mean, std, ptp, valid)
+    return scale_and_combine(
+        d_std, d_mean, d_ptp, fft_diagnostic(centred), valid,
+        chanthresh, subintthresh)
 
 
 def scale_masked(diag: jnp.ndarray, valid: jnp.ndarray, axis: int, thresh: float):
@@ -104,7 +120,20 @@ def comprehensive_stats(
     channels, / subintthresh) — reference iterative_cleaner.py:221-223.
     """
     d_std, d_mean, d_ptp, d_fft = diagnostics(weighted, valid)
+    return scale_and_combine(
+        d_std, d_mean, d_ptp, d_fft, valid, chanthresh, subintthresh)
 
+
+def fft_diagnostic(centred: jnp.ndarray) -> jnp.ndarray:
+    """max |rfft| over the bin axis of the centred residuals — the mask-blind
+    diagnostic #4 (§8.L1); shared by the XLA and Pallas-fused paths."""
+    return jnp.max(jnp.abs(jnp.fft.rfft(centred, axis=-1)), axis=-1)
+
+
+def scale_and_combine(
+    d_std, d_mean, d_ptp, d_fft, valid, chanthresh: float, subintthresh: float
+) -> jnp.ndarray:
+    """Robust-scale the four diagnostics and combine (reference :220-224)."""
     combined = []
     for diag in (d_std, d_mean, d_ptp):
         per_chan = scale_masked(diag, valid, axis=0, thresh=chanthresh)

@@ -15,7 +15,10 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-from iterative_cleaner_tpu.config import pulse_region_active
+from iterative_cleaner_tpu.config import (
+    pulse_region_active,
+    pulse_region_bin_scale,
+)
 
 _PREC = lax.Precision.HIGHEST
 
@@ -42,13 +45,7 @@ def fit_and_subtract(
     amp = jnp.where(ok, tp / jnp.where(ok, tt, 1.0), 1.0)
     resid = amp[..., None] * template - D
     if pulse_region_active(pulse_region):
-        import numpy as np
-
-        # Static bin mask built with a real Python slice so negative /
-        # out-of-range indices behave exactly as the reference's
-        # err2[start:end] *= scale (§8.L5); XLA fuses the multiply.
-        scale, start, end = pulse_region
-        bin_scale = np.ones(D.shape[-1], dtype=np.float32)
-        bin_scale[int(start) : int(end)] = scale
+        # Static bin scale (shared helper, §8.L5); XLA fuses the multiply.
+        bin_scale = pulse_region_bin_scale(D.shape[-1], pulse_region)
         resid = resid * jnp.asarray(bin_scale, dtype=resid.dtype)
     return amp, resid
