@@ -78,6 +78,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sharded_batch", action="store_true",
                    help="clean same-shape archives together, sharded over the "
                         "device mesh (one archive per dp slice)")
+    p.add_argument("--resume", action="store_true",
+                   help="skip archives whose cleaned output already exists "
+                        "(rerun an interrupted batch; default naming mode only)")
+    p.add_argument("--stream", action="store_true",
+                   help="with --sharded_batch: dispatch each same-shape "
+                        "bucket as soon as its archives are decoded, "
+                        "overlapping host I/O with device compute (bounded "
+                        "host memory; default loads the whole directory "
+                        "before dispatching)")
+    p.add_argument("--no_auto_shard", action="store_true",
+                   help="jax: never shard an oversized cube over the device "
+                        "mesh (default: cubes whose working set exceeds one "
+                        "chip's HBM are cleaned sharded when more chips exist)")
     p.add_argument("--dump_masks", action="store_true",
                    help="save the final mask (plus per-iteration history in "
                         "stepwise mode) as <output>_masks.npz")
@@ -106,6 +119,9 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         pallas=args.pallas,
         x64=args.x64,
         sharded_batch=args.sharded_batch,
+        auto_shard=not args.no_auto_shard,
+        stream=args.stream,
+        resume=args.resume,
         dump_masks=args.dump_masks,
         trace_dir=args.trace,
     )

@@ -65,6 +65,9 @@ class CleanConfig:
     pallas: bool = False           # jax: fused Pallas kernel for fit+moments
     x64: bool = False              # jax: use float64 intermediates for bit parity
     sharded_batch: bool = False    # clean same-shape archives together on the mesh
+    auto_shard: bool = True        # shard one cube over devices when it exceeds HBM
+    stream: bool = False           # sharded_batch: dispatch buckets as loads complete
+    resume: bool = False           # skip archives whose cleaned output exists
     dump_masks: bool = False       # save mask history NPZ next to the output
     trace_dir: str = ""            # jax.profiler trace output directory
 
@@ -99,6 +102,8 @@ class CleanConfig:
                              "sharded_batch=True yet; drop one of them")
         if self.sharded_batch and self.backend != "jax":
             raise ValueError("sharded_batch=True requires backend='jax'")
+        if self.stream and not self.sharded_batch:
+            raise ValueError("stream=True only applies to sharded_batch=True")
         if len(self.pulse_region) != 3:
             raise ValueError("pulse_region must have exactly 3 elements")
         object.__setattr__(self, "pulse_region", tuple(float(v) for v in self.pulse_region))

@@ -69,7 +69,19 @@ def clean_cube(
     With ``cfg.fused`` (jax backend only) the whole loop runs as one device
     dispatch; per-iteration history/progress is not tracked in that mode
     (that is its point), so ``iterations`` and ``history`` come back empty.
+
+    Cubes whose working set exceeds one device's HBM are automatically routed
+    through the (sp, tp)-sharded kernel when more devices are available
+    (BASELINE.md config #5; parallel/autoshard.py) — unless the caller needs
+    the residual cube, which the sharded kernel does not materialise.
     """
+    if cfg.backend == "jax" and cfg.auto_shard:
+        from iterative_cleaner_tpu.parallel.autoshard import maybe_clean_sharded
+
+        sharded = maybe_clean_sharded(D, w0, cfg, want_residual)
+        if sharded is not None:
+            return sharded
+
     if cfg.fused:
         from iterative_cleaner_tpu.backends.jax_backend import run_fused
 

@@ -20,7 +20,7 @@ from iterative_cleaner_tpu.io.base import Archive, get_io, known_extension as _e
 from iterative_cleaner_tpu.models.surgical import SurgicalCleaner, SurgicalOutput
 
 
-def output_name(cfg: CleanConfig, archive: Archive, path: str) -> str:
+def output_name(cfg: CleanConfig, archive: Archive | None, path: str) -> str:
     """Reference naming modes (iterative_cleaner.py:47-57):
 
     - default: ``<original name>_cleaned<ext>`` (the reference appends to the
@@ -53,6 +53,57 @@ class ArchiveReport:
     rfi_frac: float = 0.0
     converged: bool = False
     error: str | None = None
+    skipped: bool = False          # --resume: output already existed
+
+
+def split_resumable(paths: list[str], cfg: CleanConfig):
+    """--resume support (SURVEY.md §5 checkpoint/resume gap): a batch that
+    died partway is rerun with --resume and only the archives whose cleaned
+    output is not already on disk are processed.
+
+    Returns (todo_paths, skipped) with ``skipped`` keyed by the archive's
+    index in the *original* list, so the caller can hand back reports in
+    invocation order.  Only the default naming mode has a path-derivable
+    output name; 'std' and explicit -o names cannot be checked without
+    loading the archive, so --resume leaves those to run (and says so once).
+    """
+    if not cfg.resume:
+        return paths, {}
+    if cfg.output != "":
+        print("warning: --resume only skips archives in the default naming "
+              "mode (-o was given); cleaning everything", file=sys.stderr)
+        return paths, {}
+    todo, skipped = [], {}
+    for k, path in enumerate(paths):
+        # archive is only consulted by the 'std' mode, excluded above
+        o_name = output_name(cfg, None, path)
+        if os.path.exists(o_name):
+            skipped[k] = ArchiveReport(path=path, out_path=o_name, skipped=True)
+            if not cfg.quiet:
+                print(f"Resume: {o_name} exists, skipping {path}")
+        else:
+            todo.append(path)
+    return todo, skipped
+
+
+def _merge_reports(
+    n: int, skipped: dict[int, ArchiveReport], done: list[ArchiveReport]
+) -> list[ArchiveReport]:
+    """Reports in invocation order: skipped ones back at their original
+    indices, processed ones filling the gaps in sequence."""
+    it = iter(done)
+    return [skipped[k] if k in skipped else next(it) for k in range(n)]
+
+
+def atomic_save(io, archive: Archive, o_name: str) -> None:
+    """Write-then-rename so a crash mid-save never leaves a truncated file
+    under the final name — --resume trusts bare existence of the output, so
+    a partial file from a killed run would otherwise be kept as the final
+    product.  The temp name keeps the real extension (format writers key on
+    the suffix: np.savez appends .npz to anything else)."""
+    tmp = f"{o_name}.part{_ext(o_name)}"
+    io.save(archive, tmp)
+    os.replace(tmp, o_name)
 
 
 def dump_masks(
@@ -88,7 +139,7 @@ def emit_outputs(
     """The side-output block shared by the sequential and sharded-batch
     drivers: save, zap plot, mask dump, clean.log line, report."""
     o_name = output_name(cfg, archive, path)
-    io.save(cleaned, o_name)
+    atomic_save(io, cleaned, o_name)
 
     if cfg.print_zap:
         from iterative_cleaner_tpu.utils.plotting import save_zap_plot
@@ -127,12 +178,15 @@ def process_archive(
     cfg: CleanConfig,
     log_dir: str = ".",
     all_paths: list[str] | None = None,
+    archive: Archive | None = None,
 ) -> ArchiveReport:
     """Clean one archive.  ``all_paths`` is the full batch invocation (the
     reference logs the entire args Namespace, archive list included, in every
-    log line — iterative_cleaner.py:173-176)."""
+    log line — iterative_cleaner.py:173-176).  ``archive`` skips the load
+    (the prefetching batch loop decodes ahead of the device)."""
     io = get_io(path)
-    archive = io.load(path)
+    if archive is None:
+        archive = io.load(path)
 
     def progress(info):
         if not cfg.quiet:
@@ -185,27 +239,38 @@ def process_archive(
 
 
 def run_sharded_batch(
-    paths: list[str], cfg: CleanConfig, log_dir: str = ".", mesh=None
+    paths: list[str],
+    cfg: CleanConfig,
+    log_dir: str = ".",
+    mesh=None,
+    all_paths: list[str] | None = None,
 ) -> list[ArchiveReport]:
     """Multi-archive cleaning on the device mesh (one dispatch per same-shape
     bucket).  Residual archives are not produced in this mode (the fused
-    kernel does not carry them); use the sequential driver for --unload_res."""
+    kernel does not carry them); use the sequential driver for --unload_res.
+
+    In --stream mode outputs are emitted (and each item's host arrays
+    released) as its bucket finishes, so host residency stays bounded by the
+    read-ahead window; the all-at-once mode emits after the whole batch."""
     from iterative_cleaner_tpu.models.surgical import apply_output_policy
-    from iterative_cleaner_tpu.parallel.batch import clean_directory_batch
+    from iterative_cleaner_tpu.parallel.batch import (
+        clean_directory_batch,
+        clean_directory_streaming,
+    )
     from iterative_cleaner_tpu.utils.tracing import profile_trace
 
     if cfg.unload_res:
         print(
             "warning: --unload_res is not supported with --sharded_batch; "
             "residuals will not be written", file=sys.stderr)
-    with profile_trace(cfg.trace_dir):
-        items = clean_directory_batch(paths, cfg, mesh=mesh)
-    reports = []
-    for item in items:
+    invocation = all_paths if all_paths is not None else paths
+    reports: dict[int, ArchiveReport] = {}
+
+    def emit_item(i, item) -> None:
         if item.error is None:
             try:
                 cleaned = apply_output_policy(item.archive, item.weights, cfg)
-                reports.append(emit_outputs(
+                reports[i] = emit_outputs(
                     get_io(item.path),
                     item.archive,
                     item.path,
@@ -216,29 +281,76 @@ def run_sharded_batch(
                     item.rfi_frac,
                     cfg,
                     log_dir,
-                    paths,
-                ))
-                continue
+                    invocation,
+                )
+                # Release the decoded archive + masks: this is what makes
+                # --stream's host-memory bound real.
+                item.archive = item.weights = item.test_results = None
+                return
             except Exception as exc:  # noqa: BLE001 — isolate, report, continue
                 item.error = str(exc)
         print(f"ERROR cleaning {item.path}: {item.error}", file=sys.stderr)
-        reports.append(
-            ArchiveReport(path=item.path, out_path=None, error=item.error))
-    return reports
+        reports[i] = ArchiveReport(
+            path=item.path, out_path=None, error=item.error)
+
+    with profile_trace(cfg.trace_dir):
+        if cfg.stream:
+            items = clean_directory_streaming(
+                paths, cfg, mesh=mesh, on_item=emit_item)
+        else:
+            items = clean_directory_batch(paths, cfg, mesh=mesh)
+    for i, item in enumerate(items):
+        if i not in reports:  # all-at-once mode, and failed loads in stream
+            emit_item(i, item)
+    return [reports[i] for i in range(len(items))]
 
 
 def run(paths: list[str], cfg: CleanConfig, log_dir: str = ".") -> list[ArchiveReport]:
-    """Sequential batch with per-archive failure isolation.  (The sharded
-    multi-device batch lives in :mod:`.parallel.batch`.)"""
+    """Sequential batch with per-archive failure isolation and one-archive
+    read-ahead: while the device cleans archive k, a loader thread decodes
+    archive k+1 (SURVEY.md §2.4 "async" row — the reference is strictly
+    serial).  (The sharded multi-device batch lives in
+    :mod:`.parallel.batch`.)"""
+    from concurrent.futures import ThreadPoolExecutor
+
+    # clean.log records the full invocation (reference :173-176) even when
+    # resume/multi-host trims what this process actually cleans.
+    invocation = list(paths)
+    if cfg.backend == "jax":
+        # Multi-host: each process cleans its round-robin slice of the batch
+        # (identity in single-process runs).  The numpy path stays JAX-free:
+        # process_index() would initialize the device runtime.
+        from iterative_cleaner_tpu.parallel.multihost import partition_paths
+
+        paths = partition_paths(paths)
+    n_total = len(paths)
+    paths, skipped = split_resumable(paths, cfg)
     if cfg.sharded_batch:
-        return run_sharded_batch(paths, cfg, log_dir=log_dir)
-    reports = []
-    for path in paths:
+        return _merge_reports(
+            n_total, skipped,
+            run_sharded_batch(paths, cfg, log_dir=log_dir, all_paths=invocation))
+
+    def load(path: str):
         try:
-            reports.append(
-                process_archive(path, cfg, log_dir=log_dir, all_paths=paths))
+            return get_io(path).load(path), None
         except Exception as exc:  # noqa: BLE001 — isolate, report, continue
-            reports.append(ArchiveReport(path=path, out_path=None, error=str(exc)))
+            return None, str(exc)
+
+    reports = []
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        fut = pool.submit(load, paths[0]) if paths else None
+        for k, path in enumerate(paths):
+            archive, err = fut.result()
+            fut = pool.submit(load, paths[k + 1]) if k + 1 < len(paths) else None
+            if err is None:
+                try:
+                    reports.append(process_archive(
+                        path, cfg, log_dir=log_dir, all_paths=invocation,
+                        archive=archive))
+                    continue
+                except Exception as exc:  # noqa: BLE001
+                    err = str(exc)
+            reports.append(ArchiveReport(path=path, out_path=None, error=err))
             # Failures are never silenced — -q only gates progress chatter.
-            print(f"ERROR cleaning {path}: {exc}", file=sys.stderr)
-    return reports
+            print(f"ERROR cleaning {path}: {err}", file=sys.stderr)
+    return _merge_reports(n_total, skipped, reports)

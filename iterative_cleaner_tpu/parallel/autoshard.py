@@ -1,0 +1,179 @@
+"""Automatic sharding for cubes that exceed a single chip's HBM.
+
+The reference holds every archive in host RAM and has no notion of device
+memory (SURVEY.md §5 "long-context" row).  On TPU the stress config is a
+real constraint: a 1024x4096x1024 f32 cube is ~17 GB against v5e's 16 GB HBM
+(BASELINE.md config #5), so the framework must notice before the allocator
+does and route the clean through the (sp, tp)-sharded kernel, whose
+per-channel/per-subint median reductions become XLA collectives over ICI
+(parallel/sharded.py).
+
+The decision is an estimate by design: it errs toward sharding (peak factor
+measured generously) because the failure mode of not sharding is an OOM
+abort, while the cost of sharding unnecessarily is a few all-gathers.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+# Peak device working set of the fused kernel, in cube-sized units, measured
+# on TPU v5e at the bench config: the cube itself, the complex64 rfft of the
+# centred cube (nbin/2+1 bins at 8 bytes ~= one cube), the centred/weighted
+# intermediate, and the sort buffers of the masked medians (XLA fuses most
+# moment reductions into these).  History/weights/test arrays are
+# (max_iter, nsub, nchan) — noise by comparison.
+PEAK_CUBE_FACTOR = 3.5
+
+# Fraction of reported device memory treated as usable (XLA reserves some,
+# and fragmentation is real).
+HBM_USABLE_FRACTION = 0.9
+
+_ENV_OVERRIDE = "ICT_HBM_BYTES"
+
+
+def default_devices():
+    """The devices the clean would actually run on: the configured default
+    device's platform when one is set (the test harness pins CPU while the
+    process also holds a TPU backend; JAX accepts a Device or a platform
+    string there), else this process's local devices.  Local, not global:
+    the router runs inside one process's control flow, so a DCN-spanning
+    mesh here would dispatch collectives the other processes never join
+    (multihost.py promises the router 'never picks DCN spontaneously')."""
+    dev = jax.config.jax_default_device
+    if dev is not None:
+        return jax.local_devices(
+            backend=dev if isinstance(dev, str) else dev.platform)
+    return jax.local_devices()
+
+
+def device_memory_bytes(device=None) -> int | None:
+    """Best-effort per-device memory capacity.
+
+    Resolution order: the ``ICT_HBM_BYTES`` env override (tests, and hosts
+    where the runtime misreports), the device's ``memory_stats()`` limit
+    (TPU), else None (unknown — e.g. CPU backends report no limit)."""
+    env = os.environ.get(_ENV_OVERRIDE)
+    if env:
+        return int(env)
+    if device is None:
+        device = default_devices()[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001 — backend without memory introspection
+        return None
+    if stats is None:
+        return None
+    limit = stats.get("bytes_limit")
+    return int(limit) if limit else None
+
+
+def working_set_bytes(shape: tuple[int, ...], itemsize: int = 4) -> int:
+    """Estimated peak device bytes for cleaning one cube of ``shape``."""
+    n = 1
+    for dim in shape:
+        n *= int(dim)
+    return int(n * itemsize * PEAK_CUBE_FACTOR)
+
+
+def should_shard(
+    shape: tuple[int, ...],
+    device=None,
+    n_devices: int | None = None,
+    itemsize: int = 4,
+) -> bool:
+    """True when the cube's working set will not fit one device and more
+    than one device is available to spread it over.  ``itemsize`` is the
+    compute dtype's width — 8 under x64, where an f32-sized estimate would
+    undercount by half and wave an OOM through."""
+    if n_devices is None:
+        n_devices = len(default_devices())
+    if n_devices < 2:
+        return False
+    hbm = device_memory_bytes(device)
+    if hbm is None:
+        return False
+    return working_set_bytes(shape, itemsize) > hbm * HBM_USABLE_FRACTION
+
+
+def single_archive_mesh(shape: tuple[int, int, int], n_devices: int | None = None):
+    """A (dp=1, sp, tp) mesh for one oversized archive: all devices go to
+    the intra-archive axes, preferring sp (nsub, the bigger reduction axis)
+    and falling back to tp for factors nsub cannot absorb.  Axes that do not
+    divide their dimension end up replicated by batch_spec, wasting the
+    device — so factor against the actual dims."""
+    from iterative_cleaner_tpu.parallel.mesh import make_mesh
+
+    devices = default_devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    nsub, nchan = int(shape[0]), int(shape[1])
+    sp = 1
+    m = n_devices
+    # Peel prime factors into sp while they divide nsub, rest into tp.
+    p = 2
+    while m > 1 and p <= m:
+        if m % p == 0 and nsub % (sp * p) == 0:
+            sp *= p
+            m //= p
+        else:
+            p += 1
+    tp = 1
+    while m > 1:
+        p = next(q for q in range(2, m + 1) if m % q == 0)
+        if nchan % (tp * p) == 0:
+            tp *= p
+        m //= p
+    used = sp * tp
+    # Any devices we could not cleanly use stay out of the mesh entirely.
+    return make_mesh(n_devices=used, dp=1, sp=sp, tp=tp, devices=devices)
+
+
+def maybe_clean_sharded(D, w0, cfg, want_residual: bool):
+    """The auto-shard router: returns a CleanResult when the cube was
+    rerouted through the sharded kernel, None when the normal single-device
+    path should run.
+
+    Declines to reroute when the caller needs the residual cube (the fused
+    sharded kernel does not materialise it) or when no mesh axis divides the
+    cube's dims (a 1-device "sharded" run would hit the same OOM while
+    silently dropping per-loop progress).  The reroute and its consequences
+    (no per-loop progress, no mask history, pallas falling back to the XLA
+    kernel) are announced on stderr — a silent mode switch would make one
+    archive in a batch behave inexplicably differently from its neighbors.
+    """
+    import sys
+
+    from iterative_cleaner_tpu.core.cleaner import CleanResult
+    from iterative_cleaner_tpu.parallel.sharded import sharded_clean_single
+
+    itemsize = 8 if cfg.x64 else 4
+    if want_residual or not should_shard(D.shape, itemsize=itemsize):
+        return None
+    mesh = single_archive_mesh(D.shape)
+    gb = working_set_bytes(D.shape, itemsize) / 1e9
+    if mesh.devices.size == 1:
+        print(
+            f"warning: cube {tuple(D.shape)} (~{gb:.1f} GB working set) "
+            "exceeds device memory but no mesh axis divides its dims; "
+            "running unsharded — expect an allocator failure",
+            file=sys.stderr)
+        return None
+    notes = "no per-loop progress; disable with auto_shard=False"
+    if cfg.pallas:
+        notes = "pallas unavailable on the sharded route, using the XLA " \
+                "kernel; " + notes
+    print(
+        f"auto-sharding cube {tuple(D.shape)}: ~{gb:.1f} GB working set "
+        f"exceeds device memory; cleaning sharded over {mesh.devices.size} "
+        f"devices ({notes})",
+        file=sys.stderr)
+    test, w_final, loops, done = sharded_clean_single(D, w0, cfg, mesh)
+    return CleanResult(
+        weights=w_final,
+        test_results=test,
+        loops=loops,
+        converged=done,
+    )

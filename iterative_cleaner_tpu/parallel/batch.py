@@ -44,6 +44,36 @@ def _load_and_preprocess(path: str):
     return archive, D, w0
 
 
+def _require_jax_backend(cfg: CleanConfig) -> None:
+    if cfg.backend != "jax":
+        raise ValueError(
+            "clean_directory_batch shards over devices and requires "
+            "backend='jax'; use driver.run() for the sequential numpy path")
+
+
+def _finish_bucket(items, idxs, Db, w0b, cfg, mesh, on_item=None) -> None:
+    """Run one stacked bucket on the mesh and write results into its
+    BatchItems (shared by the all-at-once and streaming dispatchers).
+    ``on_item(i, item)`` fires per finished archive — the streaming driver
+    emits outputs there and releases the item's host arrays, which is what
+    makes its memory bound real."""
+    test_b, w_b, loops_b, done_b = sharded_clean(Db, w0b, cfg, mesh)
+    for j, i in enumerate(idxs):
+        item = items[i]
+        final_w = w_b[j]
+        # rfi_frac reports the iterative mask, pre-bad-parts sweep —
+        # identical to the sequential driver's ArchiveReport.rfi_frac.
+        item.rfi_frac = float((final_w == 0).mean())
+        if cfg.bad_chan != 1 or cfg.bad_subint != 1:
+            final_w, _ns, _nc = find_bad_parts(final_w, cfg)
+        item.weights = final_w
+        item.test_results = test_b[j]
+        item.loops = int(loops_b[j])
+        item.converged = bool(done_b[j])
+        if on_item is not None:
+            on_item(i, item)
+
+
 def clean_directory_batch(
     paths: list[str],
     cfg: CleanConfig,
@@ -54,10 +84,7 @@ def clean_directory_batch(
     A corrupt archive fails alone — it is reported in its BatchItem and never
     takes the bucket down (SURVEY.md §5 failure-detection gap, filled here).
     """
-    if cfg.backend != "jax":
-        raise ValueError(
-            "clean_directory_batch shards over devices and requires "
-            "backend='jax'; use driver.run() for the sequential numpy path")
+    _require_jax_backend(cfg)
     if mesh is None:
         mesh = make_mesh()
     items = [BatchItem(path=p) for p in paths]
@@ -90,17 +117,100 @@ def clean_directory_batch(
         w0b = np.stack([cubes[i][1] for i in idxs])
         for i in idxs:  # bucket cubes are stacked; release the originals
             del cubes[i]
-        test_b, w_b, loops_b, done_b = sharded_clean(Db, w0b, cfg, mesh)
-        for j, i in enumerate(idxs):
-            item = items[i]
-            final_w = w_b[j]
-            # rfi_frac reports the iterative mask, pre-bad-parts sweep —
-            # identical to the sequential driver's ArchiveReport.rfi_frac.
-            item.rfi_frac = float((final_w == 0).mean())
-            if cfg.bad_chan != 1 or cfg.bad_subint != 1:
-                final_w, _ns, _nc = find_bad_parts(final_w, cfg)
-            item.weights = final_w
-            item.test_results = test_b[j]
-            item.loops = int(loops_b[j])
-            item.converged = bool(done_b[j])
+        _finish_bucket(items, idxs, Db, w0b, cfg, mesh)
+    return items
+
+
+def clean_directory_streaming(
+    paths: list[str],
+    cfg: CleanConfig,
+    mesh: Mesh | None = None,
+    bucket_cap: int | None = None,
+    n_loaders: int = 4,
+    on_item=None,
+) -> list[BatchItem]:
+    """Streaming variant: archive decode overlaps device compute.
+
+    A loader pool decodes archives concurrently; the consumer dispatches a
+    bucket as soon as ``bucket_cap`` same-shape cubes have arrived (default:
+    the mesh's dp extent — one full data-parallel slice) while the loaders
+    keep reading ahead.  Unlike :func:`clean_directory_batch` this never
+    holds the whole directory on host: load submission is throttled, and
+    when parked sub-cap buckets (a shape-heterogeneous directory) push total
+    decoded-cube residency past ``bucket_cap + n_loaders``, the fullest
+    bucket is flushed early.  Same-shape archives split across flushes land
+    in separate dispatches — masks are per-archive either way.
+
+    The bound is only real when the caller passes ``on_item(i, item)`` and
+    releases each item's ``archive``/``weights``/``test_results`` there
+    after emitting outputs (as ``driver.run_sharded_batch`` does) — without
+    it every decoded Archive stays resident on its BatchItem.
+    """
+    from concurrent.futures import FIRST_COMPLETED, wait
+
+    _require_jax_backend(cfg)
+    if mesh is None:
+        mesh = make_mesh()
+    if bucket_cap is None:
+        bucket_cap = max(int(mesh.shape["dp"]), 1)
+    items = [BatchItem(path=p) for p in paths]
+
+    def load(i: int):
+        try:
+            items[i].archive, D, w0 = _load_and_preprocess(items[i].path)
+            return i, D, w0
+        except Exception as exc:  # noqa: BLE001 — isolate the bad archive
+            items[i].error = str(exc)
+            return i, None, None
+
+    pending: dict[tuple, list[tuple[int, np.ndarray, np.ndarray]]] = defaultdict(list)
+
+    def flush(shape, pow2: bool = False) -> None:
+        group = pending.pop(shape)
+        if pow2 and len(group) > 1:
+            # Early (pressure) flushes trim to a power-of-two batch so the
+            # fused kernel sees O(log cap) distinct batch sizes per shape
+            # instead of one jit recompile per arbitrary size; the
+            # remainder stays parked for a later flush.
+            k = 1 << (len(group).bit_length() - 1)
+            group, rest = group[:k], group[k:]
+            if rest:
+                pending[shape] = rest
+        idxs = [i for i, _, _ in group]
+        Db = np.stack([d for _, d, _ in group])
+        w0b = np.stack([w for _, _, w in group])
+        del group
+        _finish_bucket(items, idxs, Db, w0b, cfg, mesh, on_item=on_item)
+
+    # Submission is throttled to bound host memory: one new load enters the
+    # pool only as a finished one is consumed, so a device dispatch slower
+    # than decode cannot pile the whole directory into finished futures.
+    read_ahead = bucket_cap + n_loaders
+    next_idx = iter(range(len(paths)))
+    with ThreadPoolExecutor(max_workers=n_loaders) as pool:
+        from itertools import islice
+
+        futures = {pool.submit(load, i) for i in islice(next_idx, read_ahead)}
+        while futures:
+            done, futures = wait(futures, return_when=FIRST_COMPLETED)
+            for fut in done:
+                i, D, w0 = fut.result()
+                if D is not None:
+                    pending[D.shape].append((i, D, w0))
+                    # Dispatch blocks this (consumer) thread on the device;
+                    # the pool threads keep decoding the read-ahead
+                    # meanwhile.
+                    if len(pending[D.shape]) >= bucket_cap:
+                        flush(D.shape)
+                    # Parked sub-cap buckets still count against residency:
+                    # a many-shapes directory would otherwise accumulate the
+                    # whole directory in `pending`.  Flush the fullest
+                    # bucket early (a smaller dispatch, same masks).
+                    elif sum(len(g) for g in pending.values()) >= read_ahead:
+                        flush(max(pending, key=lambda s: len(pending[s])),
+                              pow2=True)
+                for j in islice(next_idx, 1):
+                    futures.add(pool.submit(load, j))
+    for shape in list(pending):
+        flush(shape)
     return items

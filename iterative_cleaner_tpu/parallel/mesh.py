@@ -11,9 +11,12 @@ parallelism maps onto three mesh axes:
   reduce across it).
 
 XLA GSPMD inserts the collectives (all-gathers for the sharded sorts, psums
-for the template reduction); nothing custom rides the wire.  Multi-host
-(DCN) extends the same mesh via ``jax.distributed.initialize`` — see
-``initialize_distributed``.
+for the template reduction); nothing custom rides the wire.  ``make_mesh``
+defaults to this process's local devices; the normal multi-host deployment
+partitions the archive batch per process (parallel/multihost.py) over
+per-host meshes.  A deliberately DCN-spanning mesh (one replicated program
+sharding a single giant cube across hosts) requires
+``initialize_distributed()`` and an explicit ``devices=jax.devices()``.
 """
 
 from __future__ import annotations
@@ -47,9 +50,17 @@ def make_mesh(
     tp: int | None = None,
     devices=None,
 ) -> Mesh:
-    """Build a ('dp', 'sp', 'tp') mesh over the first n devices."""
+    """Build a ('dp', 'sp', 'tp') mesh over the first n devices.
+
+    Defaults to this process's *local* devices: in a multi-controller run
+    every process partitions the archive batch (parallel/multihost.py) and
+    drives its own chips with its own control flow — a global mesh would
+    require identical programs on every process, which per-host path slices
+    are not.  Pass ``devices=jax.devices()`` explicitly to build a
+    DCN-spanning mesh for a single replicated program.
+    """
     if devices is None:
-        devices = jax.devices()
+        devices = jax.local_devices()
     if n_devices is None:
         n_devices = len(devices)
     devices = devices[:n_devices]
@@ -63,7 +74,9 @@ def make_mesh(
 
 
 def initialize_distributed() -> None:  # pragma: no cover - needs multi-host
-    """Multi-host entry: call once per process before building the global
-    mesh; afterwards jax.devices() spans all hosts and make_mesh shards over
-    ICI within a slice and DCN across slices."""
+    """Multi-host entry: call once per process before any device use;
+    afterwards jax.devices() spans all hosts while make_mesh still builds a
+    local mesh by default.  To shard one program across hosts over DCN, pass
+    ``make_mesh(devices=jax.devices())`` explicitly — and run the identical
+    program on every process."""
     jax.distributed.initialize()

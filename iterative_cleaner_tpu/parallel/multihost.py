@@ -1,0 +1,52 @@
+"""Multi-host (DCN) batch dispatch.
+
+Archives are embarrassingly parallel (SURVEY.md §2.4 DP row), so the
+multi-host story is deliberately thin: every host runs the same CLI over the
+same directory, each takes its round-robin slice of the path list, and no
+tensor ever crosses DCN — ICI carries the intra-archive collectives of the
+sharded kernel, DCN carries nothing but the job launch.  This mirrors how the
+reference would be scaled with a job array, but built in.
+
+For a cube too big even for one *host's* chips, the global mesh from
+``jax.distributed.initialize`` + ``make_mesh`` spans hosts and the sp/tp
+collectives ride DCN; that path works unchanged through
+``parallel.sharded`` because GSPMD is topology-agnostic — it is just slower,
+and the autoshard router never picks it spontaneously.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def process_topology() -> tuple[int, int]:
+    """(process_index, process_count) — (0, 1) in single-process runs."""
+    return jax.process_index(), jax.process_count()
+
+
+def partition_paths(
+    paths: list[str],
+    process_index: int | None = None,
+    process_count: int | None = None,
+) -> list[str]:
+    """This host's slice of a directory batch (round-robin, so hosts stay
+    balanced when archives are listed in size order)."""
+    if process_index is None or process_count is None:
+        pi, pc = process_topology()
+        process_index = pi if process_index is None else process_index
+        process_count = pc if process_count is None else process_count
+    if not 0 <= process_index < process_count:
+        raise ValueError(
+            f"process_index {process_index} not in [0, {process_count})")
+    return paths[process_index::process_count]
+
+
+def local_mesh(**kw):
+    """A mesh over this process's addressable devices only — the normal
+    multi-host deployment (one mesh per host, archives partitioned by
+    partition_paths; nothing crosses DCN).  ``make_mesh`` already defaults
+    to local devices; this alias exists so multi-host call sites say what
+    they mean."""
+    from iterative_cleaner_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(devices=jax.local_devices(), **kw)

@@ -1,0 +1,102 @@
+"""Automatic HBM-overflow sharding (BASELINE.md config #5 routing)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.ops.preprocess import preprocess
+from iterative_cleaner_tpu.parallel import autoshard
+
+
+@pytest.fixture()
+def tiny_hbm(monkeypatch):
+    """Pretend devices have 1 kB of memory so any real cube triggers the
+    sharded route."""
+    monkeypatch.setenv("ICT_HBM_BYTES", "1024")
+
+
+def test_working_set_scales_with_cube():
+    small = autoshard.working_set_bytes((8, 16, 64))
+    big = autoshard.working_set_bytes((16, 16, 64))
+    assert big == 2 * small
+    assert small == int(8 * 16 * 64 * 4 * autoshard.PEAK_CUBE_FACTOR)
+
+
+def test_env_override_wins(monkeypatch):
+    monkeypatch.setenv("ICT_HBM_BYTES", "123456")
+    assert autoshard.device_memory_bytes() == 123456
+
+
+def test_should_shard_needs_multiple_devices(tiny_hbm):
+    assert autoshard.should_shard((8, 16, 64), n_devices=1) is False
+    assert autoshard.should_shard((8, 16, 64), n_devices=8) is True
+
+
+def test_should_shard_false_when_memory_unknown(monkeypatch):
+    monkeypatch.delenv("ICT_HBM_BYTES", raising=False)
+    # CPU devices report no bytes_limit -> unknown -> never auto-shard.
+    if autoshard.device_memory_bytes(jax.devices("cpu")[0]) is None:
+        assert autoshard.should_shard((1 << 10, 1 << 10, 1 << 10)) is False
+
+
+def test_should_shard_fits(monkeypatch):
+    monkeypatch.setenv("ICT_HBM_BYTES", str(1 << 40))
+    assert autoshard.should_shard((8, 16, 64), n_devices=8) is False
+
+
+class TestSingleArchiveMesh:
+    def test_prefers_sp(self):
+        mesh = autoshard.single_archive_mesh((8, 16, 64), n_devices=8)
+        assert mesh.shape == {"dp": 1, "sp": 8, "tp": 1}
+
+    def test_spills_to_tp(self):
+        # nsub=2 can only absorb one factor of 2; the rest goes to channels.
+        mesh = autoshard.single_archive_mesh((2, 16, 64), n_devices=8)
+        assert mesh.shape == {"dp": 1, "sp": 2, "tp": 4}
+
+    def test_drops_indivisible_devices(self):
+        # nsub=3, nchan=5: no factor of 8 divides either -> single device.
+        mesh = autoshard.single_archive_mesh((3, 5, 64), n_devices=8)
+        assert mesh.devices.size == 1
+
+
+class TestAutoShardedClean:
+    def _cube(self, seed=60):
+        return preprocess(make_archive(nsub=8, nchan=16, nbin=64, seed=seed))
+
+    def test_masks_identical_to_unsharded(self, tiny_hbm):
+        D, w0 = self._cube()
+        cfg = CleanConfig(backend="jax", max_iter=4)
+        res_auto = clean_cube(D, w0, cfg)
+        # The sharded route was actually taken: the fused sharded kernel
+        # tracks no per-iteration history.
+        assert res_auto.history == [] and res_auto.iterations == []
+        res_plain = clean_cube(D, w0, cfg.replace(auto_shard=False))
+        assert res_plain.history  # and the opt-out really opted out
+        np.testing.assert_array_equal(res_auto.weights, res_plain.weights)
+        assert res_auto.loops == res_plain.loops
+        assert res_auto.converged == res_plain.converged
+
+    def test_matches_numpy_oracle(self, tiny_hbm):
+        D, w0 = self._cube(seed=61)
+        res_auto = clean_cube(D, w0, CleanConfig(backend="jax", max_iter=4))
+        res_np = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=4))
+        np.testing.assert_array_equal(res_auto.weights, res_np.weights)
+
+    def test_residual_request_stays_unsharded(self, tiny_hbm):
+        # The sharded kernel cannot materialise the residual; the request
+        # must win over the routing.
+        D, w0 = self._cube(seed=62)
+        res = clean_cube(
+            D, w0, CleanConfig(backend="jax", max_iter=3), want_residual=True)
+        assert res.residual is not None
+
+    def test_numpy_backend_never_routed(self, tiny_hbm):
+        D, w0 = self._cube(seed=63)
+        res = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=3))
+        # stepwise numpy path tracks history; sharded route would not
+        assert len(res.history) >= 2

@@ -1,0 +1,115 @@
+"""Multi-host path partitioning + --resume batch recovery."""
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.io.npz import NpzIO
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.parallel.multihost import partition_paths, process_topology
+
+
+class TestPartitionPaths:
+    def test_single_process_identity(self):
+        paths = ["a", "b", "c"]
+        assert partition_paths(paths) == paths  # (0, 1) topology
+
+    def test_round_robin(self):
+        paths = [f"p{i}" for i in range(7)]
+        slices = [partition_paths(paths, i, 3) for i in range(3)]
+        assert slices[0] == ["p0", "p3", "p6"]
+        assert slices[1] == ["p1", "p4"]
+        assert slices[2] == ["p2", "p5"]
+        # every path lands on exactly one host
+        flat = sorted(p for s in slices for p in s)
+        assert flat == sorted(paths)
+
+    def test_bad_index_rejected(self):
+        with pytest.raises(ValueError):
+            partition_paths(["a"], 3, 3)
+
+    def test_topology_single_process(self):
+        assert process_topology() == (0, 1)
+
+
+class TestResume:
+    def _write(self, tmp_path, n=3):
+        paths = []
+        for i in range(n):
+            p = str(tmp_path / f"r{i}.npz")
+            NpzIO().save(make_archive(nsub=4, nchan=16, nbin=64, seed=120 + i), p)
+            paths.append(p)
+        return paths
+
+    def test_second_run_skips_cleaned(self, tmp_path, monkeypatch):
+        from iterative_cleaner_tpu import driver
+
+        monkeypatch.chdir(tmp_path)
+        paths = self._write(tmp_path)
+        cfg = CleanConfig(backend="numpy", max_iter=2, quiet=True,
+                          no_log=True, resume=True)
+        first = driver.run(paths, cfg)
+        assert all(not r.skipped and r.error is None for r in first)
+
+        second = driver.run(paths, cfg)
+        assert all(r.skipped for r in second)
+        assert [r.out_path for r in second] == [r.out_path for r in first]
+
+    def test_partial_resume(self, tmp_path, monkeypatch):
+        from iterative_cleaner_tpu import driver
+
+        monkeypatch.chdir(tmp_path)
+        paths = self._write(tmp_path)
+        cfg = CleanConfig(backend="numpy", max_iter=2, quiet=True,
+                          no_log=True, resume=True)
+        # Pre-clean the MIDDLE archive: reports must still come back in
+        # invocation order, with the skipped one at its original index.
+        driver.run(paths[1:2], cfg)
+        reports = driver.run(paths, cfg)
+        assert [r.skipped for r in reports] == [False, True, False]
+        assert [r.path for r in reports] == paths
+        assert reports[0].loops >= 1 and reports[2].loops >= 1
+
+    def test_resume_off_reprocesses(self, tmp_path, monkeypatch):
+        from iterative_cleaner_tpu import driver
+
+        monkeypatch.chdir(tmp_path)
+        paths = self._write(tmp_path, n=1)
+        cfg = CleanConfig(backend="numpy", max_iter=2, quiet=True, no_log=True)
+        driver.run(paths, cfg)
+        reports = driver.run(paths, cfg)
+        assert not reports[0].skipped and reports[0].loops >= 1
+
+    def test_outputs_written_atomically(self, tmp_path, monkeypatch):
+        # A crash mid-save must never leave a truncated file under the final
+        # name (--resume trusts existence): saves go through write+rename.
+        import os
+        from iterative_cleaner_tpu import driver
+
+        monkeypatch.chdir(tmp_path)
+        paths = self._write(tmp_path, n=1)
+        calls = {}
+        orig_replace = os.replace
+
+        def spy(src, dst):
+            calls[dst] = src
+            return orig_replace(src, dst)
+
+        monkeypatch.setattr(os, "replace", spy)
+        cfg = CleanConfig(backend="numpy", max_iter=2, quiet=True, no_log=True)
+        reports = driver.run(paths, cfg)
+        out = reports[0].out_path
+        assert out in calls and calls[out].endswith(".part.npz")
+        assert not any(f.endswith(".part.npz") for f in os.listdir())
+        NpzIO().load(out)  # the renamed file is a complete archive
+
+    def test_resume_with_explicit_output_warns_and_runs(self, tmp_path, monkeypatch, capsys):
+        from iterative_cleaner_tpu import driver
+
+        monkeypatch.chdir(tmp_path)
+        paths = self._write(tmp_path, n=1)
+        cfg = CleanConfig(backend="numpy", max_iter=2, quiet=True,
+                          no_log=True, resume=True, output=str(tmp_path / "out.npz"))
+        reports = driver.run(paths, cfg)
+        assert not reports[0].skipped and reports[0].error is None
+        assert "--resume only skips" in capsys.readouterr().err

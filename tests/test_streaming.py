@@ -1,0 +1,86 @@
+"""Streaming bucket dispatch + sequential read-ahead (SURVEY.md §2.4 async row)."""
+
+import numpy as np
+import jax
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube
+from iterative_cleaner_tpu.io.npz import NpzIO
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.ops.preprocess import preprocess
+from iterative_cleaner_tpu.parallel.batch import clean_directory_streaming
+from iterative_cleaner_tpu.parallel.mesh import make_mesh
+
+
+def _write(tmp_path, n=4, nsub=8, seed0=70, tag="a"):
+    paths = []
+    for i in range(n):
+        p = str(tmp_path / f"{tag}{i}.npz")
+        NpzIO().save(make_archive(nsub=nsub, nchan=16, nbin=64, seed=seed0 + i), p)
+        paths.append(p)
+    return paths
+
+
+def test_streaming_matches_solo(tmp_path):
+    paths = _write(tmp_path, n=4)
+    cfg = CleanConfig(backend="jax", max_iter=3)
+    mesh = make_mesh(8, devices=jax.devices("cpu"))
+    items = clean_directory_streaming(paths, cfg, mesh=mesh)
+    assert all(it.error is None for it in items)
+    for it in items:
+        res = clean_cube(*preprocess(NpzIO().load(it.path)), cfg)
+        np.testing.assert_array_equal(it.weights, res.weights)
+        assert it.loops == res.loops
+
+
+def test_streaming_mixed_shapes_and_failures(tmp_path):
+    paths = _write(tmp_path, n=3, nsub=8, seed0=80)
+    paths += _write(tmp_path, n=2, nsub=4, seed0=90, tag="b")
+    paths.append(str(tmp_path / "missing.npz"))
+    cfg = CleanConfig(backend="jax", max_iter=3)
+    mesh = make_mesh(8, devices=jax.devices("cpu"))
+    items = clean_directory_streaming(paths, cfg, mesh=mesh, bucket_cap=2)
+    assert [it.error is None for it in items] == [True] * 5 + [False]
+    for it in items[:5]:
+        assert it.weights is not None and it.converged in (True, False)
+
+
+def test_streaming_heterogeneous_shapes_bounded_residency(tmp_path):
+    # 5 distinct shapes, cap 2, 1 loader: parked sub-cap buckets exceed the
+    # read-ahead bound and must trigger the early fullest-bucket flush, not
+    # accumulate the whole directory.
+    paths = []
+    for i, nsub in enumerate((4, 6, 8, 10, 12)):
+        p = str(tmp_path / f"h{i}.npz")
+        NpzIO().save(make_archive(nsub=nsub, nchan=16, nbin=64, seed=130 + i), p)
+        paths.append(p)
+    cfg = CleanConfig(backend="jax", max_iter=2)
+    mesh = make_mesh(8, devices=jax.devices("cpu"))
+    items = clean_directory_streaming(
+        paths, cfg, mesh=mesh, bucket_cap=2, n_loaders=1)
+    assert all(it.error is None and it.weights is not None for it in items)
+    for it in items:
+        res = clean_cube(*preprocess(NpzIO().load(it.path)), cfg)
+        np.testing.assert_array_equal(it.weights, res.weights)
+
+
+def test_streaming_partial_bucket_flush(tmp_path):
+    # 3 archives, cap 2: one full flush + one remainder flush.
+    paths = _write(tmp_path, n=3, seed0=100)
+    cfg = CleanConfig(backend="jax", max_iter=2)
+    mesh = make_mesh(8, devices=jax.devices("cpu"))
+    items = clean_directory_streaming(paths, cfg, mesh=mesh, bucket_cap=2)
+    assert all(it.weights is not None for it in items)
+
+
+def test_sequential_run_prefetch_equivalent(tmp_path, monkeypatch):
+    # run() with read-ahead produces the same reports as before, including
+    # failure isolation for an unreadable path in the middle.
+    from iterative_cleaner_tpu import driver
+
+    monkeypatch.chdir(tmp_path)
+    paths = _write(tmp_path, n=2, seed0=110)
+    paths.insert(1, str(tmp_path / "missing.npz"))
+    reports = driver.run(paths, CleanConfig(backend="jax", max_iter=3, quiet=True))
+    assert [r.error is None for r in reports] == [True, False, True]
+    assert reports[0].loops >= 1 and reports[2].loops >= 1
