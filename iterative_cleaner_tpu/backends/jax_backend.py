@@ -126,11 +126,11 @@ def fused_clean(
         init = (0, w0, history0, test0, jnp.zeros_like(D), max_iter, False)
     out = jax.lax.while_loop(cond, body, init)
     if want_residual:
-        x, w_final, _h, test, resid, loops, done = out
+        x, w_final, history, test, resid, loops, done = out
     else:
-        x, w_final, _h, test, loops, done = out
+        x, w_final, history, test, loops, done = out
         resid = None
-    return test, w_final, loops, done, x, resid
+    return test, w_final, loops, done, x, resid, history
 
 
 def _x64_dtype(cfg: CleanConfig):
@@ -179,13 +179,16 @@ class JaxCleaner:
 
 
 def run_fused(D, w0, cfg: CleanConfig, want_residual: bool = False):
-    """One-dispatch clean; returns (test, weights, loops, converged, iters[,
-    residual]) as host values.  Accepts numpy or device-resident arrays (pass
-    device arrays to keep the cube upload out of timing loops)."""
+    """One-dispatch clean; returns (test, weights, loops, converged, iters,
+    history[, residual]) as host values — history is the populated prefix of
+    the on-device ring buffer (pre-loop weights first, §8.L10), so the fused
+    mode dumps the same mask-history audit trail as the stepwise loop.
+    Accepts numpy or device-resident arrays (pass device arrays to keep the
+    cube upload out of timing loops)."""
     dtype = _x64_dtype(cfg)
     D = jnp.asarray(D, dtype)
     w0 = jnp.asarray(w0, dtype)
-    test, w_final, loops, done, x, resid = fused_clean(
+    test, w_final, loops, done, x, resid, history = fused_clean(
         D,
         w0,
         w0 != 0,
@@ -196,12 +199,15 @@ def run_fused(D, w0, cfg: CleanConfig, want_residual: bool = False):
         want_residual=want_residual,
         use_pallas=cfg.pallas and not want_residual,
     )
+    n_iters = int(x)
     out = (
         np.asarray(test),
         np.asarray(w_final),
         int(loops),
         bool(done),
-        int(x),
+        n_iters,
+        # rows 0..n_iters of the ring buffer are populated (row 0 = w0)
+        np.asarray(history[: n_iters + 1]),
     )
     if want_residual:
         out = out + (np.asarray(resid),)

@@ -96,6 +96,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "stepwise mode) as <output>_masks.npz")
     p.add_argument("--trace", type=str, default="", metavar="DIR",
                    help="write a jax.profiler trace to DIR")
+    p.add_argument("--sweep", nargs="+", default=None, metavar="C:S",
+                   help="threshold sweep mode: clean each archive under every "
+                        "given chanthresh:subintthresh pair in ONE batched "
+                        "device dispatch (thresholds are traced, so the whole "
+                        "grid shares a single compilation); prints a "
+                        "rfi_frac/loops table per archive and saves "
+                        "<archive>_sweep.npz with all masks. No cleaned "
+                        "archives are written in this mode")
     return p
 
 
@@ -127,16 +135,35 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
     )
 
 
+def parse_sweep_pairs(specs: list[str]) -> list[tuple[float, float]]:
+    pairs = []
+    for spec in specs:
+        try:
+            c, s = spec.split(":")
+            pairs.append((float(c), float(s)))
+        except ValueError:
+            raise ValueError(
+                f"bad --sweep pair {spec!r}; expected chanthresh:subintthresh "
+                "like 5:5") from None
+    return pairs
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     try:
         cfg = config_from_args(args)
+        sweep_pairs = parse_sweep_pairs(args.sweep) if args.sweep else None
     except ValueError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
-    from iterative_cleaner_tpu.driver import run
+    if sweep_pairs is not None:
+        from iterative_cleaner_tpu.driver import run_sweep
 
-    reports = run(args.archive, cfg)
+        reports = run_sweep(args.archive, cfg, sweep_pairs)
+    else:
+        from iterative_cleaner_tpu.driver import run
+
+        reports = run(args.archive, cfg)
     return 0 if all(r.error is None for r in reports) else 1
 
 

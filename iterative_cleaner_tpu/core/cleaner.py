@@ -67,8 +67,10 @@ def clean_cube(
     dedispersed.  w0: (nsub, nchan) float32 original weights.
 
     With ``cfg.fused`` (jax backend only) the whole loop runs as one device
-    dispatch; per-iteration history/progress is not tracked in that mode
-    (that is its point), so ``iterations`` and ``history`` come back empty.
+    dispatch; per-iteration host bookkeeping is not tracked in that mode
+    (that is its point), so ``iterations`` comes back empty — but
+    ``history`` is still populated from the kernel's on-device ring buffer
+    (the --dump_masks audit trail costs nothing extra).
 
     Cubes whose working set exceeds one device's HBM are automatically routed
     through the (sp, tp)-sharded kernel when more devices are available
@@ -86,13 +88,14 @@ def clean_cube(
         from iterative_cleaner_tpu.backends.jax_backend import run_fused
 
         out = run_fused(D, w0, cfg, want_residual=want_residual)
-        test, w_final, loops, done, _x = out[:5]
+        test, w_final, loops, done, _x, history = out[:6]
         return CleanResult(
             weights=w_final,
             test_results=test,
             loops=loops,
             converged=done,
-            residual=out[5] if want_residual else None,
+            history=list(history),
+            residual=out[6] if want_residual else None,
         )
 
     if want_residual and cfg.pallas:

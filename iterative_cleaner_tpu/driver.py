@@ -99,9 +99,9 @@ def atomic_save(io, archive: Archive, o_name: str) -> None:
     """Write-then-rename so a crash mid-save never leaves a truncated file
     under the final name — --resume trusts bare existence of the output, so
     a partial file from a killed run would otherwise be kept as the final
-    product.  The temp name keeps the real extension (format writers key on
-    the suffix: np.savez appends .npz to anything else)."""
-    tmp = f"{o_name}.part{_ext(o_name)}"
+    product.  Every IO backend writes to the exact path it is given (NpzIO
+    goes through a file object for this), so the temp suffix is arbitrary."""
+    tmp = f"{o_name}.part"
     io.save(archive, tmp)
     os.replace(tmp, o_name)
 
@@ -110,10 +110,10 @@ def dump_masks(
     o_name: str, history, test_results, loops: int, converged: bool
 ) -> None:
     """Mask audit dump (SURVEY.md §5 checkpoint gap) alongside the cleaned
-    archive.  ``history`` (per-iteration masks) is only tracked by the
-    stepwise path; modes that don't track it (fused, sharded batch) omit the
-    key rather than writing an empty lie — consumers check ``"history" in
-    npz``."""
+    archive.  ``history`` (per-iteration masks, pre-loop weights first) is
+    tracked by the stepwise and fused paths; the sharded batch does not
+    carry it and omits the key rather than writing an empty lie — consumers
+    check ``"history" in npz``."""
     import numpy as np
 
     payload = dict(test_results=test_results, loops=loops, converged=converged)
@@ -303,6 +303,41 @@ def run_sharded_batch(
         if i not in reports:  # all-at-once mode, and failed loads in stream
             emit_item(i, item)
     return [reports[i] for i in range(len(items))]
+
+
+def run_sweep(
+    paths: list[str], cfg: CleanConfig, pairs: list[tuple[float, float]]
+) -> list[ArchiveReport]:
+    """--sweep mode: per archive, run the whole threshold grid as one
+    batched device dispatch (models/sweep.py), print the table, save
+    ``<path>_sweep.npz``.  Exploratory — no cleaned archives, no clean.log."""
+    from iterative_cleaner_tpu.models.sweep import (
+        format_table,
+        save_sweep,
+        sweep_thresholds,
+    )
+    from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+    if cfg.backend != "jax":
+        print("error: --sweep requires --backend=jax", file=sys.stderr)
+        return [ArchiveReport(path=p, out_path=None,
+                              error="--sweep requires backend='jax'")
+                for p in paths]
+    reports = []
+    for path in paths:
+        try:
+            archive = get_io(path).load(path)
+            D, w0 = preprocess(archive)
+            points = sweep_thresholds(D, w0, cfg, pairs)
+            print(f"Sweep {path} ({len(points)} threshold pairs):")
+            print(format_table(points))
+            out = f"{path}_sweep.npz"
+            save_sweep(points, out)
+            reports.append(ArchiveReport(path=path, out_path=out))
+        except Exception as exc:  # noqa: BLE001 — isolate, report, continue
+            reports.append(ArchiveReport(path=path, out_path=None, error=str(exc)))
+            print(f"ERROR sweeping {path}: {exc}", file=sys.stderr)
+    return reports
 
 
 def run(paths: list[str], cfg: CleanConfig, log_dir: str = ".") -> list[ArchiveReport]:

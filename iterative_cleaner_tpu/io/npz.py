@@ -31,8 +31,15 @@ class NpzIO:
             )
 
     def save(self, archive: Archive, path: str) -> None:
+        # Write through a file object: np.savez with a *str* path appends
+        # .npz to unfamiliar suffixes, which breaks the driver's
+        # write-to-temp-then-rename (driver.atomic_save) for -o names.
+        with open(path, "wb") as fh:
+            self._savez(fh, archive)
+
+    def _savez(self, fh, archive: Archive) -> None:
         np.savez_compressed(
-            path,
+            fh,
             data=archive.data.astype(np.float32),
             weights=archive.weights.astype(np.float32),
             freqs=np.asarray(archive.freqs, dtype=np.float64),

@@ -1,0 +1,118 @@
+"""Threshold sweeps: the whole tuning grid in one device dispatch.
+
+Reference users tune -c/--chanthresh and -s/--subintthresh by rerunning the
+entire script per setting (the thresholds are read deep inside the stats
+kernel, reference iterative_cleaner.py:201-202).  Here the thresholds are
+*traced* scalars of the jitted kernel (backends/jax_backend.py), so a sweep
+is a ``vmap`` over (chanthresh, subintthresh) pairs: one compile, one cube
+upload, every convergence loop of the grid running batched on the chip.
+
+The per-pair outputs (final mask, rfi_frac, loops, converged) are exactly
+what a scientist scans to pick thresholds; `--sweep` prints the table and
+optionally dumps all masks for offline comparison.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from iterative_cleaner_tpu.config import CleanConfig
+
+
+@partial(jax.jit, static_argnames=("max_iter", "pulse_region"))
+def _sweep_kernel(D, w0, valid, cts, sts, *, max_iter, pulse_region):
+    from iterative_cleaner_tpu.backends.jax_backend import fused_clean
+
+    fn = lambda c, s: fused_clean(
+        D, w0, valid, c, s, max_iter=max_iter, pulse_region=pulse_region)[:4]
+    return jax.vmap(fn)(cts, sts)
+
+
+@dataclass
+class SweepPoint:
+    chanthresh: float
+    subintthresh: float
+    rfi_frac: float
+    loops: int
+    converged: bool
+    weights: np.ndarray | None = None  # final mask for this pair
+
+
+def sweep_thresholds(
+    D: np.ndarray,
+    w0: np.ndarray,
+    cfg: CleanConfig,
+    pairs: list[tuple[float, float]],
+    keep_masks: bool = True,
+) -> list[SweepPoint]:
+    """Clean one preprocessed cube under every (chanthresh, subintthresh)
+    pair — a single batched dispatch on device.  Each pair runs the full
+    convergence loop (same semantics as a solo run with those thresholds;
+    pinned by tests/test_sweep.py)."""
+    if not pairs:
+        return []
+    if cfg.backend != "jax":
+        raise ValueError("sweep_thresholds runs the batched device kernel "
+                         "and requires backend='jax'")
+    if cfg.pallas:
+        raise ValueError("sweep_thresholds does not support pallas=True "
+                         "(vmapped pallas_call is not wired up); drop one")
+    from iterative_cleaner_tpu.backends.jax_backend import _x64_dtype
+
+    dtype = _x64_dtype(cfg)  # a sweep must predict the solo runs it guides
+    D = jnp.asarray(D, dtype)
+    w0 = jnp.asarray(w0, dtype)
+    cts = jnp.asarray([float(c) for c, _ in pairs], dtype)
+    sts = jnp.asarray([float(s) for _, s in pairs], dtype)
+    test, w_final, loops, done = _sweep_kernel(
+        D, w0, w0 != 0, cts, sts,
+        max_iter=int(cfg.max_iter),
+        pulse_region=tuple(cfg.pulse_region),
+    )
+    w_final = np.asarray(w_final)
+    loops = np.asarray(loops)
+    done = np.asarray(done)
+    return [
+        SweepPoint(
+            chanthresh=float(c),
+            subintthresh=float(s),
+            rfi_frac=float((w_final[k] == 0).mean()),
+            loops=int(loops[k]),
+            converged=bool(done[k]),
+            weights=w_final[k] if keep_masks else None,
+        )
+        for k, (c, s) in enumerate(pairs)
+    ]
+
+
+def grid(chanthreshs, subintthreshs) -> list[tuple[float, float]]:
+    """Full Cartesian grid, channel-major (the order the table prints in)."""
+    return [(float(c), float(s)) for c in chanthreshs for s in subintthreshs]
+
+
+def format_table(points: list[SweepPoint]) -> str:
+    lines = ["chanthresh  subintthresh  rfi_frac  loops  converged"]
+    for p in points:
+        lines.append(
+            f"{p.chanthresh:10.3g}  {p.subintthresh:12.3g}  "
+            f"{p.rfi_frac:8.4f}  {p.loops:5d}  {str(p.converged):>9s}")
+    return "\n".join(lines)
+
+
+def save_sweep(points: list[SweepPoint], path: str) -> None:
+    """All sweep masks + metrics in one NPZ (masks stacked in pair order)."""
+    payload = dict(
+        chanthresh=np.array([p.chanthresh for p in points], np.float32),
+        subintthresh=np.array([p.subintthresh for p in points], np.float32),
+        rfi_frac=np.array([p.rfi_frac for p in points], np.float32),
+        loops=np.array([p.loops for p in points], np.int32),
+        converged=np.array([p.converged for p in points], bool),
+    )
+    if points and points[0].weights is not None:
+        payload["weights"] = np.stack([p.weights for p in points])
+    np.savez_compressed(path, **payload)

@@ -152,6 +152,16 @@ def maybe_clean_sharded(D, w0, cfg, want_residual: bool):
     itemsize = 8 if cfg.x64 else 4
     if want_residual or not should_shard(D.shape, itemsize=itemsize):
         return None
+    if cfg.x64:
+        # sharded_clean computes in the input dtype; rerouting would
+        # silently downgrade the bit-parity mode to f32.  Decline (like
+        # want_residual) and let the user shard explicitly if they must.
+        print(
+            "warning: cube exceeds device memory but --x64 is set and the "
+            "sharded kernel would drop the f64 precision; running "
+            "unsharded — expect an allocator failure",
+            file=sys.stderr)
+        return None
     mesh = single_archive_mesh(D.shape)
     gb = working_set_bytes(D.shape, itemsize) / 1e9
     if mesh.devices.size == 1:

@@ -57,9 +57,13 @@ def batch_spec(shape, mesh: Mesh) -> P:
 
 
 def shard_batch(Db, w0b, mesh: Mesh):
-    """Lay a stacked batch out on the mesh (see batch_spec)."""
-    Db = jnp.asarray(Db)
-    w0b = jnp.asarray(w0b)
+    """Lay a stacked batch out on the mesh (see batch_spec).
+
+    The host arrays go straight into the sharded ``device_put`` — a
+    ``jnp.asarray`` first would materialise the whole batch on the default
+    device, which is exactly what a >HBM cube routed here cannot survive."""
+    Db = np.asarray(Db)
+    w0b = np.asarray(w0b)
     Db = jax.device_put(Db, NamedSharding(mesh, batch_spec(Db.shape, mesh)))
     w0b = jax.device_put(w0b, NamedSharding(mesh, batch_spec(w0b.shape, mesh)))
     return Db, w0b
@@ -86,7 +90,7 @@ def sharded_clean(
     """
     Db, w0b = shard_batch(Db, w0b, mesh)
     validb = w0b != 0
-    test, w_final, loops, done, _x, _r = batched_fused_clean(
+    test, w_final, loops, done, _x, _r, _hist = batched_fused_clean(
         Db,
         w0b,
         validb,

@@ -98,11 +98,13 @@ def test_fused_matches_stepwise():
     D, w0 = preprocess(ar)
     cfg = CleanConfig(backend="jax", max_iter=5)
     res = clean_cube(D, w0, cfg, want_residual=True)
-    test_f, w_f, loops_f, conv_f, _iters_f, resid_f = run_fused(
+    test_f, w_f, loops_f, conv_f, _iters_f, hist_f, resid_f = run_fused(
         D, w0, cfg, want_residual=True)
     np.testing.assert_array_equal(res.weights, w_f)
     assert res.loops == loops_f
     assert res.converged == conv_f
+    # fused history matches the stepwise per-iteration history exactly
+    np.testing.assert_array_equal(np.stack(res.history), hist_f)
     nan_eq = np.isnan(res.test_results) == np.isnan(test_f)
     assert nan_eq.all()
     fin = np.isfinite(test_f)
@@ -117,7 +119,11 @@ def test_fused_via_clean_cube():
     res_fused = clean_cube(D, w0, CleanConfig(backend="jax", max_iter=4, fused=True))
     np.testing.assert_array_equal(res_step.weights, res_fused.weights)
     assert res_step.loops == res_fused.loops
-    assert res_fused.iterations == [] and res_fused.history == []
+    # fused mode tracks no per-iteration host info but does return the
+    # device-side mask history (for the --dump_masks audit trail)
+    assert res_fused.iterations == []
+    np.testing.assert_array_equal(
+        np.stack(res_step.history), np.stack(res_fused.history))
 
 
 def test_fused_requires_jax_backend():

@@ -99,9 +99,26 @@ class TestResume:
         cfg = CleanConfig(backend="numpy", max_iter=2, quiet=True, no_log=True)
         reports = driver.run(paths, cfg)
         out = reports[0].out_path
-        assert out in calls and calls[out].endswith(".part.npz")
-        assert not any(f.endswith(".part.npz") for f in os.listdir())
+        assert out in calls and calls[out].endswith(".part")
+        assert not any(f.endswith(".part") for f in os.listdir())
         NpzIO().load(out)  # the renamed file is a complete archive
+
+    def test_explicit_output_with_unknown_extension(self, tmp_path, monkeypatch):
+        # -o names need not carry a known extension; the writer must hit the
+        # exact path (np.savez's .npz-appending would break the atomic
+        # rename).
+        import os
+        from iterative_cleaner_tpu import driver
+
+        monkeypatch.chdir(tmp_path)
+        paths = self._write(tmp_path, n=1)
+        cfg = CleanConfig(backend="numpy", max_iter=2, quiet=True,
+                          no_log=True, output="out.dat")
+        reports = driver.run(paths, cfg)
+        assert reports[0].error is None
+        assert os.path.exists("out.dat")
+        assert not any(".part" in f for f in os.listdir())
+        NpzIO().load("out.dat")
 
     def test_resume_with_explicit_output_warns_and_runs(self, tmp_path, monkeypatch, capsys):
         from iterative_cleaner_tpu import driver

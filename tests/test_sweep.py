@@ -1,0 +1,106 @@
+"""Threshold-sweep mode: the grid in one dispatch matches solo runs."""
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube
+from iterative_cleaner_tpu.io.npz import NpzIO
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.models.sweep import (
+    format_table,
+    grid,
+    save_sweep,
+    sweep_thresholds,
+)
+from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+
+@pytest.fixture(scope="module")
+def cube():
+    return preprocess(make_archive(nsub=8, nchan=16, nbin=64, seed=140))
+
+
+def test_sweep_matches_solo_runs(cube):
+    D, w0 = cube
+    pairs = [(3.0, 3.0), (5.0, 5.0), (8.0, 2.5)]
+    points = sweep_thresholds(D, w0, CleanConfig(backend="jax", max_iter=4), pairs)
+    assert len(points) == 3
+    for p in points:
+        solo = clean_cube(D, w0, CleanConfig(
+            backend="jax", max_iter=4, fused=True,
+            chanthresh=p.chanthresh, subintthresh=p.subintthresh))
+        np.testing.assert_array_equal(p.weights, solo.weights)
+        assert p.loops == solo.loops
+        assert p.converged == solo.converged
+        assert p.rfi_frac == pytest.approx(solo.rfi_frac)
+
+
+def test_sweep_matches_numpy_oracle(cube):
+    D, w0 = cube
+    points = sweep_thresholds(
+        D, w0, CleanConfig(backend="jax", max_iter=4), [(4.0, 4.0)])
+    res = clean_cube(D, w0, CleanConfig(
+        backend="numpy", max_iter=4, chanthresh=4.0, subintthresh=4.0))
+    np.testing.assert_array_equal(points[0].weights, res.weights)
+
+
+def test_tighter_thresholds_zap_no_less(cube):
+    D, w0 = cube
+    points = sweep_thresholds(
+        D, w0, CleanConfig(backend="jax", max_iter=4),
+        [(2.0, 2.0), (10.0, 10.0)])
+    assert points[0].rfi_frac >= points[1].rfi_frac
+
+
+def test_grid_order():
+    assert grid([3, 5], [4, 6]) == [(3.0, 4.0), (3.0, 6.0), (5.0, 4.0), (5.0, 6.0)]
+
+
+def test_requires_jax(cube):
+    D, w0 = cube
+    with pytest.raises(ValueError, match="jax"):
+        sweep_thresholds(D, w0, CleanConfig(backend="numpy"), [(5.0, 5.0)])
+
+
+def test_empty_pairs(cube):
+    D, w0 = cube
+    assert sweep_thresholds(D, w0, CleanConfig(backend="jax"), []) == []
+
+
+def test_format_and_save(cube, tmp_path):
+    D, w0 = cube
+    points = sweep_thresholds(
+        D, w0, CleanConfig(backend="jax", max_iter=3), [(5.0, 5.0), (3.0, 7.0)])
+    table = format_table(points)
+    assert "rfi_frac" in table and len(table.splitlines()) == 3
+    out = str(tmp_path / "s.npz")
+    save_sweep(points, out)
+    z = np.load(out)
+    assert z["weights"].shape == (2,) + w0.shape
+    assert list(z["chanthresh"]) == [5.0, 3.0]
+
+
+def test_cli_sweep_mode(tmp_path, monkeypatch, capsys):
+    from iterative_cleaner_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    p = str(tmp_path / "a.npz")
+    NpzIO().save(make_archive(nsub=8, nchan=16, nbin=64, seed=141), p)
+    rc = main([p, "--backend=jax", "--sweep", "3:3", "5:5"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Sweep" in out and "rfi_frac" in out
+    z = np.load(f"{p}_sweep.npz")
+    assert z["weights"].shape[0] == 2
+    # no cleaned archive in sweep mode
+    import os
+    assert not os.path.exists(f"{p}_cleaned.npz")
+
+
+def test_cli_sweep_bad_pair(tmp_path):
+    from iterative_cleaner_tpu.cli import main
+
+    p = str(tmp_path / "a.npz")
+    NpzIO().save(make_archive(nsub=4, nchan=8, nbin=32, seed=142), p)
+    assert main([p, "--sweep", "nonsense"]) == 2
