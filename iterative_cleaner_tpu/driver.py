@@ -323,6 +323,11 @@ def run_sweep(
         return [ArchiveReport(path=p, out_path=None,
                               error="--sweep requires backend='jax'")
                 for p in paths]
+    # Same multi-host split as run(): without it every process would sweep
+    # every archive and race on the same _sweep.npz outputs.
+    from iterative_cleaner_tpu.parallel.multihost import partition_paths
+
+    paths = partition_paths(paths)
     reports = []
     for path in paths:
         try:

@@ -67,27 +67,55 @@ def sweep_thresholds(
     dtype = _x64_dtype(cfg)  # a sweep must predict the solo runs it guides
     D = jnp.asarray(D, dtype)
     w0 = jnp.asarray(w0, dtype)
-    cts = jnp.asarray([float(c) for c, _ in pairs], dtype)
-    sts = jnp.asarray([float(s) for _, s in pairs], dtype)
-    test, w_final, loops, done = _sweep_kernel(
-        D, w0, w0 != 0, cts, sts,
-        max_iter=int(cfg.max_iter),
-        pulse_region=tuple(cfg.pulse_region),
+    valid = w0 != 0
+
+    # vmap batches the kernel's cube-sized intermediates over the pairs, so
+    # peak HBM is ~n_pairs x a solo run's working set; chunk the grid to
+    # what the device can hold (each chunk size is one compilation; at most
+    # two distinct sizes occur).
+    from iterative_cleaner_tpu.parallel.autoshard import (
+        HBM_USABLE_FRACTION,
+        device_memory_bytes,
+        working_set_bytes,
     )
-    w_final = np.asarray(w_final)
-    loops = np.asarray(loops)
-    done = np.asarray(done)
-    return [
-        SweepPoint(
-            chanthresh=float(c),
-            subintthresh=float(s),
-            rfi_frac=float((w_final[k] == 0).mean()),
-            loops=int(loops[k]),
-            converged=bool(done[k]),
-            weights=w_final[k] if keep_masks else None,
+
+    chunk = len(pairs)
+    hbm = device_memory_bytes()
+    if hbm is not None:
+        per_pair = working_set_bytes(D.shape, int(jnp.dtype(dtype).itemsize))
+        chunk = max(1, min(chunk, int(hbm * HBM_USABLE_FRACTION // per_pair)))
+        if chunk < len(pairs):
+            import sys
+
+            print(
+                f"sweep: running {len(pairs)} pairs in chunks of {chunk} "
+                "(full grid would exceed device memory)", file=sys.stderr)
+
+    points: list[SweepPoint] = []
+    for start in range(0, len(pairs), chunk):
+        part = pairs[start:start + chunk]
+        cts = jnp.asarray([float(c) for c, _ in part], dtype)
+        sts = jnp.asarray([float(s) for _, s in part], dtype)
+        test, w_final, loops, done = _sweep_kernel(
+            D, w0, valid, cts, sts,
+            max_iter=int(cfg.max_iter),
+            pulse_region=tuple(cfg.pulse_region),
         )
-        for k, (c, s) in enumerate(pairs)
-    ]
+        w_final = np.asarray(w_final)
+        loops = np.asarray(loops)
+        done = np.asarray(done)
+        points.extend(
+            SweepPoint(
+                chanthresh=float(c),
+                subintthresh=float(s),
+                rfi_frac=float((w_final[k] == 0).mean()),
+                loops=int(loops[k]),
+                converged=bool(done[k]),
+                weights=w_final[k] if keep_masks else None,
+            )
+            for k, (c, s) in enumerate(part)
+        )
+    return points
 
 
 def grid(chanthreshs, subintthreshs) -> list[tuple[float, float]]:

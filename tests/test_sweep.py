@@ -53,6 +53,23 @@ def test_tighter_thresholds_zap_no_less(cube):
     assert points[0].rfi_frac >= points[1].rfi_frac
 
 
+def test_sweep_chunks_under_tight_hbm(cube, monkeypatch, capsys):
+    # With a tiny pretended HBM the grid must split into per-pair chunks and
+    # still produce exactly the solo-run masks.
+    D, w0 = cube
+    monkeypatch.setenv("ICT_HBM_BYTES", str(
+        int(D.size * 4 * 3.5 * 1.5)))  # room for ~1 pair's working set
+    pairs = [(3.0, 3.0), (5.0, 5.0), (7.0, 7.0)]
+    points = sweep_thresholds(
+        D, w0, CleanConfig(backend="jax", max_iter=3, auto_shard=False), pairs)
+    assert "chunks of 1" in capsys.readouterr().err
+    for p in points:
+        solo = clean_cube(D, w0, CleanConfig(
+            backend="jax", max_iter=3, fused=True, auto_shard=False,
+            chanthresh=p.chanthresh, subintthresh=p.subintthresh))
+        np.testing.assert_array_equal(p.weights, solo.weights)
+
+
 def test_grid_order():
     assert grid([3, 5], [4, 6]) == [(3.0, 4.0), (3.0, 6.0), (5.0, 4.0), (5.0, 6.0)]
 
