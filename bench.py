@@ -31,12 +31,38 @@ NCHAN = int(os.environ.get("BENCH_NCHAN", 1024))
 NBIN = int(os.environ.get("BENCH_NBIN", 1024))
 TARGET_SPEEDUP = 20.0  # BASELINE.md north star
 
+# The dev TPU sits behind a tunnel that can wedge hard (device init then
+# blocks forever, before any timeout the script could wrap around an op).
+# A watchdog thread guarantees the driver always gets its one JSON line.
+WATCHDOG_S = float(os.environ.get("BENCH_WATCHDOG_S", 2400))
+
+
+def _start_watchdog():
+    import threading
+
+    def fire():
+        print(json.dumps({
+            "metric": f"clean_per_iter_speedup_jax_vs_numpy_{NSUB}x{NCHAN}x{NBIN}",
+            "value": 0.0,
+            "unit": "x",
+            "vs_baseline": 0.0,
+            "error": f"watchdog: bench did not finish within {WATCHDOG_S:.0f}s "
+                     "(TPU tunnel unresponsive?)",
+        }), flush=True)
+        os._exit(2)
+
+    t = threading.Timer(WATCHDOG_S, fire)
+    t.daemon = True
+    t.start()
+    return t
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
 def main() -> None:
+    watchdog = _start_watchdog()
     import jax
     import jax.numpy as jnp
 
@@ -115,6 +141,9 @@ def main() -> None:
     log(f"speedup (per iteration): {speedup:.1f}x  "
         f"[target {TARGET_SPEEDUP:.0f}x]")
 
+    # Success line flushed BEFORE disarming, so a teardown stall after a
+    # near-deadline finish can neither drop it (block-buffered pipe) nor
+    # let the watchdog overwrite a run that actually completed.
     print(json.dumps({
         "metric": f"clean_per_iter_speedup_jax_vs_numpy_{NSUB}x{NCHAN}x{NBIN}",
         "value": round(speedup, 2),
@@ -127,7 +156,8 @@ def main() -> None:
         "upload_s": round(t_upload, 2),
         "iterations": iters,
         "device": f"{dev.platform}:{dev.device_kind}",
-    }))
+    }), flush=True)
+    watchdog.cancel()
 
 
 if __name__ == "__main__":

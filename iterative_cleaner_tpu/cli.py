@@ -96,6 +96,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "stepwise mode) as <output>_masks.npz")
     p.add_argument("--trace", type=str, default="", metavar="DIR",
                    help="write a jax.profiler trace to DIR")
+    p.add_argument("--report", type=str, default="", metavar="PATH",
+                   help="write a machine-readable JSON run report (one object "
+                        "per archive: output, loops, rfi_frac, converged, "
+                        "error) after the batch finishes")
     p.add_argument("--sweep", nargs="+", default=None, metavar="C:S",
                    help="threshold sweep mode: clean each archive under every "
                         "given chanthresh:subintthresh pair in ONE batched "
@@ -164,6 +168,10 @@ def main(argv: list[str] | None = None) -> int:
         from iterative_cleaner_tpu.driver import run
 
         reports = run(args.archive, cfg)
+    if args.report:
+        from iterative_cleaner_tpu.driver import write_report
+
+        write_report(reports, args.report, cfg)
     return 0 if all(r.error is None for r in reports) else 1
 
 

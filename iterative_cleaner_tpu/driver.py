@@ -305,6 +305,35 @@ def run_sharded_batch(
     return [reports[i] for i in range(len(items))]
 
 
+def write_report(
+    reports: list[ArchiveReport], path: str, cfg: CleanConfig | None = None
+) -> None:
+    """Machine-readable batch summary (--report): one JSON object per
+    archive, written atomically.  The reference's only machine-readable
+    artifact is the free-text clean.log (iterative_cleaner.py:173-176);
+    pipelines that schedule thousands of archives need a parseable verdict.
+
+    In a multi-host run each process holds only its slice of the batch, so
+    the path gets a per-process suffix — otherwise the hosts would all
+    os.replace the same file and the last writer's slice would masquerade
+    as the whole batch."""
+    import dataclasses
+    import json
+
+    if cfg is not None and cfg.backend == "jax":
+        from iterative_cleaner_tpu.parallel.multihost import process_topology
+
+        pi, pc = process_topology()
+        if pc > 1:
+            path = f"{path}.p{pi}"
+    payload = [dataclasses.asdict(r) for r in reports]
+    tmp = f"{path}.part"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh, indent=2)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
 def run_sweep(
     paths: list[str], cfg: CleanConfig, pairs: list[tuple[float, float]]
 ) -> list[ArchiveReport]:

@@ -33,6 +33,9 @@ def _sweep_kernel(D, w0, valid, cts, sts, *, max_iter, pulse_region):
     return jax.vmap(fn)(cts, sts)
 
 
+_announced_chunkings: set = set()
+
+
 @dataclass
 class SweepPoint:
     chanthresh: float
@@ -84,7 +87,11 @@ def sweep_thresholds(
     if hbm is not None:
         per_pair = working_set_bytes(D.shape, int(jnp.dtype(dtype).itemsize))
         chunk = max(1, min(chunk, int(hbm * HBM_USABLE_FRACTION // per_pair)))
-        if chunk < len(pairs):
+        key = (tuple(D.shape), str(dtype), chunk, len(pairs))
+        if chunk < len(pairs) and key not in _announced_chunkings:
+            # Announce once per distinct decision — a 1000-archive batch
+            # sweep must not print 1000 identical lines.
+            _announced_chunkings.add(key)
             import sys
 
             print(

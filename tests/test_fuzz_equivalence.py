@@ -64,6 +64,23 @@ def test_jax_matches_numpy_fuzzed(seed):
     assert res_np.converged == res_jx.converged == res_fu.converged
 
 
+@pytest.mark.parametrize("seed", range(20, 23))
+def test_multipol_matches_numpy_fuzzed(seed):
+    # Multi-pol archives go through the pscrunch preprocess (Coherence:
+    # pol0+pol1); backend equivalence must hold there too.
+    rng = np.random.default_rng(seed)
+    archive = make_archive(
+        nsub=int(rng.integers(4, 10)), nchan=16, nbin=64,
+        npol=int(rng.choice([2, 4])), seed=seed + 20_000)
+    D, w0 = preprocess(archive)
+    kw = dict(chanthresh=float(rng.uniform(3, 7)),
+              subintthresh=float(rng.uniform(3, 7)), max_iter=4)
+    res_np = clean_cube(D, w0, CleanConfig(backend="numpy", **kw))
+    res_jx = clean_cube(D, w0, CleanConfig(backend="jax", fused=True, **kw))
+    np.testing.assert_array_equal(res_np.weights, res_jx.weights)
+    assert res_np.loops == res_jx.loops
+
+
 @pytest.mark.parametrize("seed", range(12, 16))
 def test_sharded_matches_numpy_fuzzed(seed):
     import jax

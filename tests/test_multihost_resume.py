@@ -120,6 +120,22 @@ class TestResume:
         assert not any(".part" in f for f in os.listdir())
         NpzIO().load("out.dat")
 
+    def test_report_file(self, tmp_path, monkeypatch):
+        import json
+        from iterative_cleaner_tpu.cli import main
+
+        monkeypatch.chdir(tmp_path)
+        paths = self._write(tmp_path, n=2)
+        paths.append(str(tmp_path / "missing.npz"))
+        rc = main(paths + ["--backend=numpy", "-q", "-l",
+                           "--report", "report.json"])
+        assert rc == 1  # the missing archive fails
+        with open("report.json") as fh:
+            rep = json.load(fh)
+        assert [r["error"] is None for r in rep] == [True, True, False]
+        assert rep[0]["loops"] >= 1 and rep[0]["out_path"].endswith("_cleaned.npz")
+        assert 0.0 <= rep[0]["rfi_frac"] <= 1.0
+
     def test_resume_with_explicit_output_warns_and_runs(self, tmp_path, monkeypatch, capsys):
         from iterative_cleaner_tpu import driver
 
