@@ -1,20 +1,35 @@
 """Benchmark: JAX/TPU fused clean vs the preserved numpy path.
 
-Measures per-iteration wall clock of the cleaning kernel on a LOFAR-HBA-scale
-synthetic archive (BASELINE.md config #2: 256 subint x 1024 chan x 1024 bin,
-1.07 GB f32) and verifies flag-mask parity along the way.
+Two shape classes, both with flag-mask parity checks along the way:
 
-Prints ONE JSON line on stdout:
-  {"metric": ..., "value": speedup, "unit": "x", "vs_baseline": ...}
-- value: numpy-step time / jax-per-iteration time, both on this machine
-  (the north-star metric: clean() wall-clock vs the preserved numpy path);
-- vs_baseline: value / 20.0 — fraction of the >=20x BASELINE.md target.
+- **config A** (BASELINE.md config #2 class): 256 subint x 1024 chan x 1024
+  bin (1.07 GB f32).  Full numpy ``clean()`` measured end-to-end, fused JAX
+  loop cold + warm, per-phase device timings with an HBM-bandwidth model,
+  the compiled Pallas arm, and the single-chip chunked (>HBM) arm.
+- **config B** (the BASELINE.md north-star shape class): 1024 subint x 4096
+  chan x 256 bin (4.3 GB f32) — the 1024x4096 profile grid of the north
+  star at an nbin whose working set fits one v5e chip.  numpy is measured
+  for one step and extrapolated (its per-iteration cost is
+  iteration-invariant); JAX is measured end-to-end.
 
-Everything else (sizes, phase timings, parity) goes to stderr.  The one-off
-host->device cube upload is reported separately and excluded from the
-per-iteration figure (the kernel is HBM-resident by design; on this dev
-environment the chip sits behind a ~25 MB/s tunnel that a real TPU host
-never sees).
+Prints ONE JSON line on stdout.  Headline metric: **end-to-end** clean()
+speedup at config A — numpy wall-clock / (upload + compile + fused run),
+nothing excluded.  The same payload reports the warm (compile-amortised)
+and per-iteration views, per-phase timings, achieved HBM bandwidth, and a
+clearly-labelled projection of the end-to-end figure onto a real TPU host's
+PCIe (this dev environment reaches the chip through a ~37 MB/s tunnel that
+dominates upload; a real host moves GB/s — the projection substitutes only
+that constant, measured compute times are untouched).
+
+Robustness (VERDICT r02 ask #4): every exit path emits the one JSON line —
+a watchdog covers hangs, a top-level handler covers exceptions (with the
+partial payload gathered so far), device init gets a bounded retry, and
+each optional section (pallas / chunked / config B) is isolated so one
+failure degrades the payload instead of zeroing it.
+
+Env knobs: BENCH_NSUB/NCHAN/NBIN (config A), BENCH_B_NSUB/NCHAN/NBIN,
+BENCH_MAX_ITER, BENCH_WATCHDOG_S, BENCH_SKIP_NORTHSTAR/PALLAS/CHUNKED/
+PHASES, BENCH_FULL_NUMPY=0 (downgrade config A numpy to one step).
 """
 
 from __future__ import annotations
@@ -29,26 +44,59 @@ import numpy as np
 NSUB = int(os.environ.get("BENCH_NSUB", 256))
 NCHAN = int(os.environ.get("BENCH_NCHAN", 1024))
 NBIN = int(os.environ.get("BENCH_NBIN", 1024))
+B_NSUB = int(os.environ.get("BENCH_B_NSUB", 1024))
+B_NCHAN = int(os.environ.get("BENCH_B_NCHAN", 4096))
+B_NBIN = int(os.environ.get("BENCH_B_NBIN", 256))
+MAX_ITER = int(os.environ.get("BENCH_MAX_ITER", 5))
 TARGET_SPEEDUP = 20.0  # BASELINE.md north star
-
-# The dev TPU sits behind a tunnel that can wedge hard (device init then
-# blocks forever, before any timeout the script could wrap around an op).
-# A watchdog thread guarantees the driver always gets its one JSON line.
 WATCHDOG_S = float(os.environ.get("BENCH_WATCHDOG_S", 2400))
+
+# Real-host PCIe assumption for the clearly-labelled projection (GB/s).
+REAL_HOST_PCIE_GBPS = 8.0
+# v5e-lite HBM peak, for the bandwidth-efficiency figure.
+HBM_PEAK_GBPS = {"TPU v5 lite": 819.0}
+
+# Cube-sized HBM-traffic model per phase of the XLA step (reads + writes in
+# cube units; the basis for phase_gbps).  template: read D once.  fit: read
+# D for <D,t>, read D again for the residual, write the residual.  moments:
+# read the residual, write the centred cube (weight/centre/moment reductions
+# fuse).  fft: read the centred cube, write (nbin/2+1) complex64 bins ~= one
+# cube.  scalers: (nsub, nchan) maps — no cube traffic.
+PHASE_CUBE_PASSES = {"template": 1.0, "fit": 3.0, "moments": 2.0,
+                     "fft": 2.0, "scalers": 0.0}
+
+_PAYLOAD: dict = {}   # filled incrementally; error paths dump what exists
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _emit(payload: dict) -> None:
+    print(json.dumps(payload), flush=True)
+
+
+def _headline(payload: dict) -> dict:
+    """Order the one-line JSON: driver keys first, then the detail."""
+    value = payload.get("end_to_end_speedup", 0.0)
+    out = {
+        "metric": f"clean_end_to_end_speedup_jax_vs_numpy_{NSUB}x{NCHAN}x{NBIN}",
+        "value": round(float(value), 2),
+        "unit": "x",
+        "vs_baseline": round(float(value) / TARGET_SPEEDUP, 3),
+    }
+    out.update(payload)
+    return out
 
 
 def _start_watchdog():
     import threading
 
     def fire():
-        print(json.dumps({
-            "metric": f"clean_per_iter_speedup_jax_vs_numpy_{NSUB}x{NCHAN}x{NBIN}",
-            "value": 0.0,
-            "unit": "x",
-            "vs_baseline": 0.0,
-            "error": f"watchdog: bench did not finish within {WATCHDOG_S:.0f}s "
-                     "(TPU tunnel unresponsive?)",
-        }), flush=True)
+        payload = dict(_PAYLOAD)
+        payload["error"] = (f"watchdog: bench did not finish within "
+                            f"{WATCHDOG_S:.0f}s (TPU tunnel unresponsive?)")
+        _emit(_headline(payload))
         os._exit(2)
 
     t = threading.Timer(WATCHDOG_S, fire)
@@ -57,12 +105,44 @@ def _start_watchdog():
     return t
 
 
-def log(msg: str) -> None:
-    print(msg, file=sys.stderr, flush=True)
+def _init_device(retries: int = 3, sleep_s: float = 20.0):
+    """Bounded retry around backend init: the dev tunnel's failure mode is a
+    transient RPC error on first contact (r01's bench died to exactly this)."""
+    import jax
+
+    last = None
+    for attempt in range(retries):
+        try:
+            dev = jax.devices()[0]
+            log(f"device: {dev.platform} ({dev.device_kind})"
+                + (f" [attempt {attempt + 1}]" if attempt else ""))
+            return dev
+        except Exception as exc:  # noqa: BLE001 — retried, then reported
+            last = exc
+            log(f"device init attempt {attempt + 1}/{retries} failed: {exc}")
+            time.sleep(sleep_s)
+    raise RuntimeError(f"device init failed after {retries} attempts: {last}")
 
 
-def main() -> None:
-    watchdog = _start_watchdog()
+def _force(x) -> None:
+    """Force completion via a tiny fetch (block_until_ready is unreliable on
+    the axon tunnel platform; fetching a scalar is not)."""
+    import jax.numpy as jnp
+
+    np.asarray(jnp.sum(x))
+
+
+def _min_time(fn, n: int = 3) -> float:
+    times = []
+    for _ in range(n):
+        t0 = time.time()
+        fn()
+        times.append(time.time() - t0)
+    return min(times)
+
+
+def _bench_config(tag, nsub, nchan, nbin, *, full_numpy, dev):
+    """Measure one shape class; returns a dict of timings/parities."""
     import jax
     import jax.numpy as jnp
 
@@ -73,92 +153,378 @@ def main() -> None:
     from iterative_cleaner_tpu.io.synthetic import make_archive
     from iterative_cleaner_tpu.ops.preprocess import preprocess
 
-    dev = jax.devices()[0]
-    log(f"device: {dev.platform} ({dev.device_kind})")
-
-    # --- parity gate on a quick config (full loop, both backends) ---
+    out: dict = {"shape": [nsub, nchan, nbin]}
     t0 = time.time()
-    ar_small = make_archive(nsub=64, nchan=256, nbin=512, seed=42)
-    Ds, w0s = preprocess(ar_small)
-    res_np = clean_cube(Ds, w0s, CleanConfig(backend="numpy", max_iter=5))
-    res_jx = clean_cube(Ds, w0s, CleanConfig(backend="jax", max_iter=5, fused=True))
-    parity = bool(np.array_equal(res_np.weights, res_jx.weights))
-    log(f"parity gate (64x256x512): identical={parity} "
-        f"loops={res_np.loops}/{res_jx.loops} [{time.time() - t0:.1f}s]")
-
-    # --- the measured config ---
-    t0 = time.time()
-    ar = make_archive(nsub=NSUB, nchan=NCHAN, nbin=NBIN, seed=42)
+    ar = make_archive(nsub=nsub, nchan=nchan, nbin=nbin, seed=42)
     D, w0 = preprocess(ar)
-    log(f"cube {D.shape} = {D.nbytes / 1e9:.2f} GB f32 "
+    del ar
+    cube_gb = D.nbytes / 1e9
+    out["cube_gb"] = round(cube_gb, 3)
+    log(f"[{tag}] cube {D.shape} = {cube_gb:.2f} GB f32 "
         f"[gen+preprocess {time.time() - t0:.1f}s]")
 
-    # numpy path: one step (its per-iteration cost is iteration-invariant).
-    cleaner = NumpyCleaner(D, w0, CleanConfig(backend="numpy"))
-    t0 = time.time()
-    _test_np, _w_np = cleaner.step(w0)
-    t_numpy_step = time.time() - t0
-    log(f"numpy per-iteration: {t_numpy_step:.2f}s")
+    # --- numpy side ---
+    mask_np_step1 = None
+    if full_numpy:
+        t0 = time.time()
+        res_np = clean_cube(
+            D, w0, CleanConfig(backend="numpy", max_iter=MAX_ITER))
+        t_numpy_full = time.time() - t0
+        n_np = len(res_np.iterations)
+        t_numpy_step = t_numpy_full / max(n_np, 1)
+        mask_np_step1 = res_np.history[1]
+        out.update(numpy_full_clean_s=round(t_numpy_full, 2),
+                   numpy_loops=res_np.loops, numpy_iters=n_np,
+                   numpy_step_s=round(t_numpy_step, 2),
+                   numpy_e2e_measured=True)
+        log(f"[{tag}] numpy full clean: {t_numpy_full:.1f}s "
+            f"({n_np} iterations, {t_numpy_step:.1f}s/iter)")
+    else:
+        cleaner = NumpyCleaner(D, w0, CleanConfig(backend="numpy"))
+        t0 = time.time()
+        _test, mask_np_step1 = cleaner.step(w0)
+        t_numpy_step = time.time() - t0
+        out.update(numpy_step_s=round(t_numpy_step, 2),
+                   numpy_e2e_measured=False)
+        log(f"[{tag}] numpy per-iteration: {t_numpy_step:.1f}s "
+            "(full clean extrapolated: per-iteration cost is "
+            "iteration-invariant)")
+        del cleaner
 
-    # jax path: upload once, then the fused loop, timed via forced fetch
-    # (block_until_ready is unreliable on the axon tunnel platform).
+    # --- JAX: upload ---
     t0 = time.time()
     Dd = jax.device_put(jnp.asarray(D))
     w0d = jax.device_put(jnp.asarray(w0))
     validd = w0d != 0
-    np.asarray(jnp.sum(w0d))  # force completion
+    _force(w0d)
+    _force(Dd)
     t_upload = time.time() - t0
-    log(f"host->device upload: {t_upload:.2f}s "
-        f"({D.nbytes / 1e6 / max(t_upload, 1e-9):.0f} MB/s)")
+    upload_gbps = D.nbytes / 1e9 / max(t_upload, 1e-9)
+    out.update(upload_s=round(t_upload, 2),
+               upload_gbps=round(upload_gbps, 4))
+    log(f"[{tag}] host->device upload: {t_upload:.2f}s "
+        f"({upload_gbps * 1e3:.0f} MB/s)")
 
-    kw = dict(max_iter=5, pulse_region=(0.0, 0.0, 1.0))
+    # --- JAX: fused loop, cold then warm ---
+    kw = dict(max_iter=MAX_ITER, pulse_region=(0.0, 0.0, 1.0))
     t0 = time.time()
-    out = fused_clean(Dd, w0d, validd, 5.0, 5.0, **kw)
-    w_jax = np.asarray(out[1])
-    iters = int(out[4])
-    t_compile_and_run = time.time() - t0
-    log(f"fused compile+run: {t_compile_and_run:.2f}s ({iters} iterations)")
+    fused_out = fused_clean(Dd, w0d, validd, 5.0, 5.0, **kw)
+    w_jax = np.asarray(fused_out[1])
+    iters = int(fused_out[4])
+    t_cold = time.time() - t0
+    t_warm = _min_time(lambda: np.asarray(
+        fused_clean(Dd, w0d, validd, 5.0, 5.0, **kw)[1]))
+    t_jax_step = t_warm / max(iters, 1)
+    out.update(jax_cold_compile_run_s=round(t_cold, 2),
+               jax_warm_loop_s=round(t_warm, 4),
+               jax_step_s=round(t_jax_step, 4), iterations=iters)
+    log(f"[{tag}] fused cold: {t_cold:.2f}s; warm: {t_warm:.3f}s "
+        f"({iters} iterations, {t_jax_step:.4f}s/iter)")
 
-    times = []
-    for _ in range(3):
-        t0 = time.time()
-        out = fused_clean(Dd, w0d, validd, 5.0, 5.0, **kw)
-        np.asarray(out[1])
-        times.append(time.time() - t0)
-    t_jax_loop = min(times)
-    t_jax_step = t_jax_loop / max(iters, 1)
-    log(f"fused warm: {t_jax_loop:.3f}s total, {t_jax_step:.3f}s/iteration")
-
-    # Parity at the measured scale: iteration 1 of both paths (the fused
-    # loop's final weights are only comparable when iters == 1, so compare a
-    # single explicit step instead — cheap on device).
+    # --- parity ---
     step1 = clean_step(Dd, w0d, validd, w0d, 5.0, 5.0,
                        pulse_region=(0.0, 0.0, 1.0))
-    big_parity = bool(np.array_equal(np.asarray(step1[1]), _w_np))
-    log(f"parity at {NSUB}x{NCHAN}x{NBIN} (iteration 1): {big_parity}")
+    w_step1 = np.asarray(step1[1])
+    out["parity_iter1"] = bool(np.array_equal(w_step1, mask_np_step1))
+    if full_numpy:
+        out["parity_full_loop"] = bool(
+            np.array_equal(w_jax, res_np.weights)
+            and iters == len(res_np.iterations))
+    log(f"[{tag}] parity: iter1={out['parity_iter1']}"
+        + (f" full_loop={out['parity_full_loop']}" if full_numpy else ""))
 
-    speedup = t_numpy_step / t_jax_step
-    log(f"speedup (per iteration): {speedup:.1f}x  "
-        f"[target {TARGET_SPEEDUP:.0f}x]")
+    # --- end-to-end ---
+    numpy_e2e = (out.get("numpy_full_clean_s")
+                 or t_numpy_step * max(iters, 1))
+    jax_e2e_cold = t_upload + t_cold
+    jax_e2e_warm = t_upload + t_warm
+    t_upload_proj = D.nbytes / 1e9 / REAL_HOST_PCIE_GBPS
+    out.update(
+        numpy_e2e_s=round(numpy_e2e, 2),
+        jax_e2e_cold_s=round(jax_e2e_cold, 2),
+        jax_e2e_warm_s=round(jax_e2e_warm, 2),
+        end_to_end_speedup=round(numpy_e2e / jax_e2e_cold, 2),
+        end_to_end_speedup_warm=round(numpy_e2e / jax_e2e_warm, 2),
+        per_iteration_speedup=round(t_numpy_step / t_jax_step, 1),
+        # Projection: same measured compute, real-host PCIe for the upload.
+        end_to_end_speedup_projected_real_host=round(
+            numpy_e2e / (t_upload_proj + t_warm), 1),
+        projection_assumes_pcie_gbps=REAL_HOST_PCIE_GBPS,
+    )
+    log(f"[{tag}] end-to-end speedup: {out['end_to_end_speedup']}x cold, "
+        f"{out['end_to_end_speedup_warm']}x warm, "
+        f"{out['per_iteration_speedup']}x per-iteration, "
+        f"{out['end_to_end_speedup_projected_real_host']}x projected on a "
+        f"{REAL_HOST_PCIE_GBPS:.0f} GB/s host link")
 
+    # --- device memory peak (validates autoshard.PEAK_CUBE_FACTOR) ---
+    try:
+        stats = dev.memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        if peak:
+            out["device_peak_bytes"] = int(peak)
+            out["peak_cube_factor_measured"] = round(peak / D.nbytes, 2)
+    except Exception:  # noqa: BLE001 — introspection is best-effort
+        pass
+
+    return out, (D, w0, Dd, w0d, validd, w_step1)
+
+
+def _bench_phases(state, dev_kind) -> dict:
+    """Cumulative-ablation per-phase timings of one XLA step + HBM GB/s."""
+    import jax
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends.jax_backend import clean_step
+    from iterative_cleaner_tpu.ops.stats import fft_diagnostic
+    from iterative_cleaner_tpu.ops.template import build_template, fit_and_subtract
+
+    D, w0, Dd, w0d, validd, _ = state
+    cube_bytes = D.nbytes
+
+    @jax.jit
+    def p_template(D, w):
+        return jnp.sum(build_template(D, w))
+
+    @jax.jit
+    def p_fit(D, w):
+        t = build_template(D, w)
+        _amp, resid = fit_and_subtract(D, t, (0.0, 0.0, 1.0))
+        return jnp.sum(resid)
+
+    @jax.jit
+    def p_fft(D, w, w0):
+        t = build_template(D, w)
+        _amp, resid = fit_and_subtract(D, t, (0.0, 0.0, 1.0))
+        weighted = resid * w0[..., None]
+        centred = weighted - jnp.mean(weighted, axis=-1, keepdims=True)
+        return jnp.sum(fft_diagnostic(centred))
+
+    # diagnostics() computes the fft too, so the moments stage rebuilds just
+    # the moment part (same ops, same order).
+    @jax.jit
+    def p_moments_only(D, w, w0, valid):
+        from iterative_cleaner_tpu.ops.stats import fill_moments
+
+        t = build_template(D, w)
+        _amp, resid = fit_and_subtract(D, t, (0.0, 0.0, 1.0))
+        weighted = resid * w0[..., None]
+        mean = jnp.mean(weighted, axis=-1)
+        centred = weighted - mean[..., None]
+        std = jnp.sqrt(jnp.mean(centred * centred, axis=-1))
+        ptp = jnp.max(weighted, axis=-1) - jnp.min(weighted, axis=-1)
+        d_mean, d_std, d_ptp = fill_moments(mean, std, ptp, valid)
+        return jnp.sum(d_std) + jnp.sum(d_mean) + jnp.sum(d_ptp)
+
+    def run_full():
+        np.asarray(clean_step(Dd, w0d, validd, w0d, 5.0, 5.0,
+                              pulse_region=(0.0, 0.0, 1.0))[1])
+
+    stages = [
+        ("template", lambda: _force(p_template(Dd, w0d))),
+        ("fit", lambda: _force(p_fit(Dd, w0d))),
+        ("moments", lambda: _force(p_moments_only(Dd, w0d, w0d, validd))),
+        ("fft", lambda: _force(p_fft(Dd, w0d, w0d))),
+        ("full_step", run_full),
+    ]
+    cum = {}
+    for name, fn in stages:
+        fn()  # compile
+        cum[name] = _min_time(fn)
+    deltas = {
+        "template": cum["template"],
+        "fit": cum["fit"] - cum["template"],
+        "moments": cum["moments"] - cum["fit"],
+        "fft": cum["fft"] - cum["moments"],
+        "scalers": cum["full_step"] - cum["fft"],
+    }
+    phase_s = {k: round(max(v, 0.0), 4) for k, v in deltas.items()}
+    phase_gbps = {}
+    for k, passes in PHASE_CUBE_PASSES.items():
+        if passes and deltas[k] > 1e-5:
+            phase_gbps[k] = round(passes * cube_bytes / 1e9 / deltas[k], 1)
+    total_passes = sum(PHASE_CUBE_PASSES.values())
+    achieved = total_passes * cube_bytes / 1e9 / max(cum["full_step"], 1e-9)
+    res = {
+        "phase_s": phase_s,
+        "phase_gbps_model": phase_gbps,
+        "phase_cube_passes_model": PHASE_CUBE_PASSES,
+        "unfused_step_s": round(cum["full_step"], 4),
+        "achieved_gbps": round(achieved, 1),
+    }
+    peak = HBM_PEAK_GBPS.get(dev_kind)
+    if peak:
+        res["hbm_peak_gbps"] = peak
+        res["hbm_efficiency"] = round(achieved / peak, 3)
+    log(f"[phases] {phase_s} achieved ~{achieved:.0f} GB/s "
+        f"(model: {total_passes:.0f} cube passes/step)")
+    return res
+
+
+def _bench_pallas(state) -> dict:
+    """Compiled Pallas arm: fused loop with the one-HBM-pass kernel."""
+    import jax
+
+    from iterative_cleaner_tpu.backends.jax_backend import fused_clean
+    from iterative_cleaner_tpu.ops.pallas_kernels import (
+        pallas_route_ok,
+        use_interpret,
+    )
+
+    D, w0, Dd, w0d, validd, _ = state
+    nbin = D.shape[-1]
+    if use_interpret() or not pallas_route_ok(nbin):
+        return {"skipped": f"pallas route not viable here "
+                           f"(platform={jax.default_backend()}, nbin={nbin})"}
+    kw = dict(max_iter=MAX_ITER, pulse_region=(0.0, 0.0, 1.0),
+              use_pallas=True)
+    t0 = time.time()
+    out = fused_clean(Dd, w0d, validd, 5.0, 5.0, **kw)
+    w_pallas = np.asarray(out[1])
+    iters = int(out[4])
+    t_cold = time.time() - t0
+    t_warm = _min_time(lambda: np.asarray(
+        fused_clean(Dd, w0d, validd, 5.0, 5.0, **kw)[1]))
+    # Parity vs the XLA fused route at the same config.
+    w_xla = np.asarray(fused_clean(
+        Dd, w0d, validd, 5.0, 5.0, max_iter=MAX_ITER,
+        pulse_region=(0.0, 0.0, 1.0))[1])
+    res = {
+        "cold_compile_run_s": round(t_cold, 2),
+        "warm_loop_s": round(t_warm, 4),
+        "step_s": round(t_warm / max(iters, 1), 4),
+        "iterations": iters,
+        "parity_vs_xla": bool(np.array_equal(w_pallas, w_xla)),
+    }
+    log(f"[pallas] compiled: cold {t_cold:.2f}s, warm {t_warm:.3f}s, "
+        f"parity_vs_xla={res['parity_vs_xla']}")
+    return res
+
+
+def _bench_chunked(state) -> dict:
+    """Single-chip >HBM streaming arm (parallel/chunked.py): the cube stays
+    in host RAM and subint blocks stream through the device — here forced at
+    a fitting size so the overhead is measurable against the in-memory step.
+    Two cube uploads per iteration through this environment's tunnel
+    dominate; the per-iteration device compute is the honest remainder."""
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.parallel.chunked import ChunkedJaxCleaner
+
+    D, w0, _Dd, _w0d, _validd, w_step1 = state
+    block = max(1, D.shape[0] // 4)
+    backend = ChunkedJaxCleaner(
+        D, w0, CleanConfig(backend="jax"), block=block)
+    t0 = time.time()
+    _test, w1 = backend.step(w0)
+    t_first = time.time() - t0
+    t0 = time.time()
+    backend.step(w1)
+    t_step = time.time() - t0
+    res = {
+        "block_subints": block,
+        "first_step_s": round(t_first, 2),
+        "warm_step_s": round(t_step, 2),
+        "parity_iter1_vs_in_memory": bool(np.array_equal(w1, w_step1)),
+        "note": "2 cube uploads/iteration by design; wall clock is "
+                "upload-dominated on this tunnel environment",
+    }
+    log(f"[chunked] block={block}: first {t_first:.1f}s, warm {t_step:.1f}s/"
+        f"iter, parity={res['parity_iter1_vs_in_memory']}")
+    return res
+
+
+def run_bench() -> dict:
+    dev = _init_device()
+    _PAYLOAD["device"] = f"{dev.platform}:{dev.device_kind}"
+
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.core.cleaner import clean_cube
+    from iterative_cleaner_tpu.io.synthetic import make_archive
+    from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+    # --- small parity gate (full loop, both backends) ---
+    t0 = time.time()
+    Ds, w0s = preprocess(make_archive(nsub=64, nchan=256, nbin=512, seed=42))
+    res_np = clean_cube(Ds, w0s, CleanConfig(backend="numpy", max_iter=5))
+    res_jx = clean_cube(
+        Ds, w0s, CleanConfig(backend="jax", max_iter=5, fused=True))
+    _PAYLOAD["parity_small_config"] = bool(
+        np.array_equal(res_np.weights, res_jx.weights))
+    log(f"parity gate (64x256x512): identical="
+        f"{_PAYLOAD['parity_small_config']} "
+        f"loops={res_np.loops}/{res_jx.loops} [{time.time() - t0:.1f}s]")
+
+    # --- config A ---
+    full_numpy = os.environ.get("BENCH_FULL_NUMPY", "1") != "0"
+    out_a, state = _bench_config(
+        "A", NSUB, NCHAN, NBIN, full_numpy=full_numpy, dev=dev)
+    _PAYLOAD["config_a"] = out_a
+    # Promote config A's headline numbers to the top level.
+    for k in ("end_to_end_speedup", "end_to_end_speedup_warm",
+              "per_iteration_speedup",
+              "end_to_end_speedup_projected_real_host",
+              "numpy_e2e_s", "jax_e2e_cold_s", "jax_e2e_warm_s",
+              "upload_s", "iterations", "parity_iter1"):
+        if k in out_a:
+            _PAYLOAD[k] = out_a[k]
+    if "parity_full_loop" in out_a:
+        _PAYLOAD["parity_measured_config_full_loop"] = out_a["parity_full_loop"]
+
+    sections = []
+    if os.environ.get("BENCH_SKIP_PHASES", "0") == "0":
+        sections.append(("phases", lambda: _bench_phases(state, dev.device_kind)))
+    if os.environ.get("BENCH_SKIP_PALLAS", "0") == "0":
+        sections.append(("pallas", lambda: _bench_pallas(state)))
+    if os.environ.get("BENCH_SKIP_CHUNKED", "0") == "0":
+        sections.append(("chunked", lambda: _bench_chunked(state)))
+    for name, fn in sections:
+        try:
+            _PAYLOAD[name] = fn()
+        except Exception as exc:  # noqa: BLE001 — isolate optional sections
+            log(f"[{name}] FAILED: {exc}")
+            _PAYLOAD[name] = {"error": str(exc)}
+    if "achieved_gbps" in _PAYLOAD.get("phases", {}):
+        _PAYLOAD["achieved_gbps"] = _PAYLOAD["phases"]["achieved_gbps"]
+
+    del state
+
+    # --- config B: the north-star shape class ---
+    if os.environ.get("BENCH_SKIP_NORTHSTAR", "0") == "0":
+        try:
+            out_b, state_b = _bench_config(
+                "B", B_NSUB, B_NCHAN, B_NBIN, full_numpy=False, dev=dev)
+            _PAYLOAD["config_b_north_star_shape"] = out_b
+            del state_b
+        except Exception as exc:  # noqa: BLE001 — isolate optional sections
+            log(f"[B] FAILED: {exc}")
+            _PAYLOAD["config_b_north_star_shape"] = {"error": str(exc)}
+
+    _PAYLOAD["tunnel_note"] = (
+        "upload runs through a dev tunnel at ~tens of MB/s; a real TPU host "
+        "moves GB/s over PCIe — see end_to_end_speedup_projected_real_host")
+    return _PAYLOAD
+
+
+def main() -> int:
+    watchdog = _start_watchdog()
+    try:
+        payload = run_bench()
+    except Exception as exc:  # noqa: BLE001 — every exit path emits JSON
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        payload = dict(_PAYLOAD)
+        payload["error"] = f"{type(exc).__name__}: {exc}"
+        _emit(_headline(payload))
+        watchdog.cancel()
+        return 1
     # Success line flushed BEFORE disarming, so a teardown stall after a
     # near-deadline finish can neither drop it (block-buffered pipe) nor
     # let the watchdog overwrite a run that actually completed.
-    print(json.dumps({
-        "metric": f"clean_per_iter_speedup_jax_vs_numpy_{NSUB}x{NCHAN}x{NBIN}",
-        "value": round(speedup, 2),
-        "unit": "x",
-        "vs_baseline": round(speedup / TARGET_SPEEDUP, 3),
-        "parity_small_config": parity,
-        "parity_measured_config_iter1": big_parity,
-        "numpy_step_s": round(t_numpy_step, 2),
-        "jax_step_s": round(t_jax_step, 4),
-        "upload_s": round(t_upload, 2),
-        "iterations": iters,
-        "device": f"{dev.platform}:{dev.device_kind}",
-    }), flush=True)
+    _emit(_headline(payload))
     watchdog.cancel()
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

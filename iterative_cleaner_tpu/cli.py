@@ -68,7 +68,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="compute backend (default: jax)")
     p.add_argument("--fused", action="store_true",
                    help="jax: run the whole iteration loop as one device "
-                        "dispatch (no per-loop progress output)")
+                        "dispatch (per-loop progress is derived afterwards "
+                        "from the on-device mask history)")
     p.add_argument("--pallas", action="store_true",
                    help="jax: use the fused Pallas TPU kernel for the "
                         "fit+moments hot path (one HBM pass over the cube; "

@@ -45,13 +45,28 @@ class CleanResult:
     def rfi_frac(self) -> float:
         if self.iterations:
             return self.iterations[-1].rfi_frac
-        # Fused mode tracks no per-iteration info; derive from the final
-        # weights (identical to the stepwise final-iteration value: zapped
-        # entries are exactly 0.0).
+        # The sharded reroute tracks no per-iteration info; derive from the
+        # final weights (identical to the stepwise final-iteration value:
+        # zapped entries are exactly 0.0).
         return float((self.weights == 0).mean())
 
 
 ProgressFn = Callable[[IterationInfo], None]
+
+
+def _iteration_info(
+    index: int, prev_w: np.ndarray, new_w: np.ndarray, duration_s: float = 0.0
+) -> IterationInfo:
+    """The per-loop record the reference prints (diff vs previous weights,
+    zapped fraction — iterative_cleaner.py:127-133); shared by the stepwise
+    loop and the fused path's post-hoc derivation so the two can never
+    diverge."""
+    return IterationInfo(
+        index=index,
+        diff_weights=int(np.sum(new_w != prev_w)),
+        rfi_frac=float((new_w.size - np.count_nonzero(new_w)) / new_w.size),
+        duration_s=duration_s,
+    )
 
 
 def clean_cube(
@@ -67,34 +82,71 @@ def clean_cube(
     dedispersed.  w0: (nsub, nchan) float32 original weights.
 
     With ``cfg.fused`` (jax backend only) the whole loop runs as one device
-    dispatch; per-iteration host bookkeeping is not tracked in that mode
-    (that is its point), so ``iterations`` comes back empty — but
-    ``history`` is still populated from the kernel's on-device ring buffer
-    (the --dump_masks audit trail costs nothing extra).
+    dispatch; the per-loop ``iterations`` records (and ``progress``
+    callbacks — the reference's per-loop diff/rfi_frac prints,
+    iterative_cleaner.py:132-133) are derived *post hoc* from the kernel's
+    on-device weight-history ring buffer, so ``--fused`` without ``-q``
+    prints the same loop lines as the stepwise path.  Only ``duration_s``
+    stays 0 — a single dispatch has no per-iteration host wall-clock.
 
     Cubes whose working set exceeds one device's HBM are automatically routed
     through the (sp, tp)-sharded kernel when more devices are available
-    (BASELINE.md config #5; parallel/autoshard.py) — unless the caller needs
-    the residual cube, which the sharded kernel does not materialise.
+    (BASELINE.md config #5; parallel/autoshard.py); when sharding is
+    unavailable (one chip — the v5e-1 north-star target) or unsuitable
+    (--x64, --unload_res, mesh-indivisible dims) the cube streams through
+    the single-device chunked backend instead (parallel/chunked.py) — a
+    stepwise path, so progress / history / residual all keep working.
     """
+    chunk_block = None
     if cfg.backend == "jax" and cfg.auto_shard:
-        from iterative_cleaner_tpu.parallel.autoshard import maybe_clean_sharded
+        from iterative_cleaner_tpu.parallel.autoshard import (
+            chunk_block_subints,
+            maybe_clean_sharded,
+        )
 
         sharded = maybe_clean_sharded(D, w0, cfg, want_residual)
         if sharded is not None:
             return sharded
+        chunk_block = chunk_block_subints(D.shape, cfg)
+        if chunk_block is not None:
+            import sys
 
-    if cfg.fused:
+            notes = []
+            if cfg.fused:
+                notes.append("fused loop runs stepwise on this path")
+            if cfg.pallas:
+                notes.append("pallas unavailable on this path, using the "
+                             "XLA kernels")
+            if cfg.x64:
+                notes.append("x64: block-wise template accumulation "
+                             "reorders the f64 sum, so bit-identity of "
+                             "intermediate values vs the in-memory path "
+                             "is not guaranteed")
+            print(
+                f"chunked clean: cube {tuple(D.shape)} exceeds device "
+                f"memory; streaming {chunk_block}-subint blocks through "
+                f"the device{' (' + '; '.join(notes) + ')' if notes else ''}",
+                file=sys.stderr)
+
+    if cfg.fused and chunk_block is None:
         from iterative_cleaner_tpu.backends.jax_backend import run_fused
 
         out = run_fused(D, w0, cfg, want_residual=want_residual)
         test, w_final, loops, done, _x, history = out[:6]
+        history = list(history)
+        infos = []
+        for i in range(1, len(history)):
+            info = _iteration_info(i, history[i - 1], history[i])
+            infos.append(info)
+            if progress is not None:
+                progress(info)
         return CleanResult(
             weights=w_final,
             test_results=test,
             loops=loops,
             converged=done,
-            history=list(history),
+            iterations=infos,
+            history=history,
             residual=out[6] if want_residual else None,
         )
 
@@ -102,7 +154,13 @@ def clean_cube(
         # The Pallas kernel does not materialise the residual; fall back to
         # the XLA route for this request, exactly as run_fused does.
         cfg = cfg.replace(pallas=False)
-    backend = make_backend(D, w0, cfg)
+    if chunk_block is not None:
+        from iterative_cleaner_tpu.parallel.chunked import ChunkedJaxCleaner
+
+        backend = ChunkedJaxCleaner(
+            D, w0, cfg, block=chunk_block, keep_residual=want_residual)
+    else:
+        backend = make_backend(D, w0, cfg)
     w0 = np.asarray(w0, dtype=np.float32)
 
     history: list[np.ndarray] = [w0.copy()]
@@ -120,12 +178,7 @@ def clean_cube(
         test_results = np.asarray(test_results)
         new_w = np.asarray(new_w)
 
-        info = IterationInfo(
-            index=x,
-            diff_weights=int(np.sum(new_w != history[-1])),
-            rfi_frac=float((new_w.size - np.count_nonzero(new_w)) / new_w.size),
-            duration_s=timer.lap(),
-        )
+        info = _iteration_info(x, history[-1], new_w, duration_s=timer.lap())
         infos.append(info)
         if progress is not None:
             progress(info)

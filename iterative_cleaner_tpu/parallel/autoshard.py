@@ -19,12 +19,14 @@ import os
 
 import jax
 
-# Peak device working set of the fused kernel, in cube-sized units, measured
-# on TPU v5e at the bench config: the cube itself, the complex64 rfft of the
-# centred cube (nbin/2+1 bins at 8 bytes ~= one cube), the centred/weighted
-# intermediate, and the sort buffers of the masked medians (XLA fuses most
-# moment reductions into these).  History/weights/test arrays are
-# (max_iter, nsub, nchan) — noise by comparison.
+# Peak device working set of the fused kernel, in cube-sized units: the cube
+# itself, the complex64 rfft of the centred cube (nbin/2+1 bins at 8 bytes
+# ~= one cube), the centred/weighted intermediate, and the sort buffers of
+# the masked medians (XLA fuses most moment reductions into these).
+# History/weights/test arrays are (max_iter, nsub, nchan) — noise by
+# comparison.  bench.py validates this constant on hardware every run:
+# BENCH_r*.json carries `peak_cube_factor_measured` (the device's
+# peak_bytes_in_use / cube bytes at the bench config).
 PEAK_CUBE_FACTOR = 3.5
 
 # Fraction of reported device memory treated as usable (XLA reserves some,
@@ -131,16 +133,43 @@ def single_archive_mesh(shape: tuple[int, int, int], n_devices: int | None = Non
     return make_mesh(n_devices=used, dp=1, sp=sp, tp=tp, devices=devices)
 
 
+def chunk_block_subints(shape: tuple[int, ...], cfg) -> int | None:
+    """Subint slab size for the single-device streaming backend
+    (:class:`.chunked.ChunkedJaxCleaner`), or None when the cube's working
+    set fits the device.
+
+    This is the route of last resort behind :func:`maybe_clean_sharded` —
+    the answer for an oversized cube when sharding is unavailable (one chip:
+    the v5e-1 north-star target vs config #5's 17 GB working set) or
+    unsuitable (mesh-indivisible dims, --x64 bit-parity, --unload_res).
+    Half the usable budget per slab: consecutive blocks' device buffers
+    briefly coexist across the upload/compute boundary.
+    """
+    itemsize = 8 if cfg.x64 else 4
+    hbm = device_memory_bytes()
+    if hbm is None:
+        return None
+    usable = hbm * HBM_USABLE_FRACTION
+    if working_set_bytes(shape, itemsize) <= usable:
+        return None
+    per_sub = working_set_bytes((1, *shape[1:]), itemsize)
+    block = int(usable / 2 // per_sub)
+    return max(1, min(block, int(shape[0])))
+
+
 def maybe_clean_sharded(D, w0, cfg, want_residual: bool):
     """The auto-shard router: returns a CleanResult when the cube was
-    rerouted through the sharded kernel, None when the normal single-device
-    path should run.
+    rerouted through the multi-device sharded kernel, None when the caller
+    should run a single-device path (the normal in-memory one, or — if
+    :func:`chunk_block_subints` says the cube does not fit — the chunked
+    streaming backend; :mod:`..core.cleaner` consults it next).
 
     Declines to reroute when the caller needs the residual cube (the fused
-    sharded kernel does not materialise it) or when no mesh axis divides the
-    cube's dims (a 1-device "sharded" run would hit the same OOM while
-    silently dropping per-loop progress).  The reroute and its consequences
-    (no per-loop progress, no mask history, pallas falling back to the XLA
+    sharded kernel does not materialise it), when --x64 is set (the sharded
+    kernel would silently drop the f64 bit-parity mode), or when no mesh
+    axis divides the cube's dims — in all three cases the chunked backend
+    picks the cube up instead.  The reroute and its consequences (no
+    per-loop progress, no mask history, pallas falling back to the XLA
     kernel) are announced on stderr — a silent mode switch would make one
     archive in a batch behave inexplicably differently from its neighbors.
     """
@@ -150,25 +179,15 @@ def maybe_clean_sharded(D, w0, cfg, want_residual: bool):
     from iterative_cleaner_tpu.parallel.sharded import sharded_clean_single
 
     itemsize = 8 if cfg.x64 else 4
-    if want_residual or not should_shard(D.shape, itemsize=itemsize):
-        return None
-    if cfg.x64:
-        # sharded_clean computes in the input dtype; rerouting would
-        # silently downgrade the bit-parity mode to f32.  Decline (like
-        # want_residual) and let the user shard explicitly if they must.
-        print(
-            "warning: cube exceeds device memory but --x64 is set and the "
-            "sharded kernel would drop the f64 precision; running "
-            "unsharded — expect an allocator failure",
-            file=sys.stderr)
+    if want_residual or cfg.x64 or not should_shard(D.shape, itemsize=itemsize):
         return None
     mesh = single_archive_mesh(D.shape)
     gb = working_set_bytes(D.shape, itemsize) / 1e9
     if mesh.devices.size == 1:
         print(
-            f"warning: cube {tuple(D.shape)} (~{gb:.1f} GB working set) "
-            "exceeds device memory but no mesh axis divides its dims; "
-            "running unsharded — expect an allocator failure",
+            f"note: cube {tuple(D.shape)} (~{gb:.1f} GB working set) exceeds "
+            "device memory but no mesh axis divides its dims; using the "
+            "single-device chunked path",
             file=sys.stderr)
         return None
     notes = "no per-loop progress; disable with auto_shard=False"

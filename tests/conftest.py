@@ -9,19 +9,33 @@ import os
 
 # The XLA_FLAGS must be in place before the CPU backend initializes (it is
 # lazy, so this works even though the dev environment's sitecustomize has
-# already imported jax and eagerly initialized the axon TPU backend, which
-# also ignores any later JAX_PLATFORMS override).  Tests then run on the
-# virtual 8-device CPU platform; set ICT_TEST_TPU=1 to use the real chip.
+# already imported jax and registered the axon TPU plugin).  Tests then run
+# on the virtual 8-device CPU platform; set ICT_TEST_TPU=1 to use the real
+# chip.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+if not os.environ.get("ICT_TEST_TPU"):
+    # Force, don't setdefault: the dev environment exports
+    # JAX_PLATFORMS=axon, and the first backends() init would otherwise
+    # initialize the remote axon TPU plugin — which HANGS every test
+    # session whenever the dev tunnel is wedged (observed live in r03).
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
 import numpy as np
 import pytest
 
 if not os.environ.get("ICT_TEST_TPU"):
+    # The env var alone is not enough: sitecustomize's plugin registration
+    # already read jax_platforms ("axon"), so the config holds the stale
+    # value and the first backends() would still try the axon plugin.  The
+    # config update makes "cpu" stick, so only the CPU backend is ever
+    # initialized.  (Do NOT deregister the other backend *factories* —
+    # registration is what makes the "tpu" platform known to MLIR, and
+    # Pallas imports fail without it.)
+    jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 from iterative_cleaner_tpu.io.synthetic import make_archive, RFISpec

@@ -1,0 +1,194 @@
+"""Single-device chunked streaming backend (BASELINE.md config #5 on one
+chip): mask parity vs the in-memory paths, residual support, and the
+autoshard → chunked routing."""
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.ops.preprocess import preprocess
+from iterative_cleaner_tpu.parallel import autoshard
+from iterative_cleaner_tpu.parallel.chunked import ChunkedJaxCleaner
+
+
+def _cube(seed=80, nsub=8, nchan=16, nbin=64):
+    return preprocess(make_archive(nsub=nsub, nchan=nchan, nbin=nbin, seed=seed))
+
+
+@pytest.mark.parametrize("block", [1, 3, 8])
+def test_chunked_step_matches_in_memory(block):
+    """Every block size — including a ragged last block — produces the same
+    *mask* as the monolithic JAX step.  The float test scores carry ~ulp
+    wobble for partial blocks (block-wise template accumulation reorders
+    the f32 sum — documented in parallel/chunked.py); a full-cube block
+    (block=8) has no reordering and must be bit-exact throughout."""
+    from iterative_cleaner_tpu.backends.jax_backend import JaxCleaner
+
+    D, w0 = _cube()
+    cfg = CleanConfig(backend="jax")
+    test_m, w_m = JaxCleaner(D, w0, cfg).step(w0)
+    test_c, w_c = ChunkedJaxCleaner(D, w0, cfg, block=block).step(w0)
+    np.testing.assert_array_equal(w_c, w_m)
+    fin = np.isfinite(test_m)
+    assert (np.isnan(test_c) == np.isnan(test_m)).all()
+    np.testing.assert_allclose(test_c[fin], test_m[fin], rtol=1e-5)
+    if block == 8:
+        np.testing.assert_array_equal(test_c, test_m)
+
+
+def test_chunked_full_loop_matches_numpy_oracle():
+    D, w0 = _cube(seed=81)
+    cfg = CleanConfig(backend="jax", max_iter=4)
+    backend = ChunkedJaxCleaner(D, w0, cfg, block=3)
+    w_prev = w0
+    for _ in range(cfg.max_iter):
+        _t, w_new = backend.step(w_prev)
+        if np.array_equal(w_new, w_prev):
+            break
+        w_prev = w_new
+    res_np = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=4))
+    np.testing.assert_array_equal(w_prev, res_np.weights)
+
+
+def test_chunked_residual_matches_in_memory():
+    D, w0 = _cube(seed=82)
+    cfg = CleanConfig(backend="jax")
+    from iterative_cleaner_tpu.backends.jax_backend import JaxCleaner
+
+    mono = JaxCleaner(D, w0, cfg)
+    mono.step(w0)
+    chunked = ChunkedJaxCleaner(D, w0, cfg, block=3, keep_residual=True)
+    chunked.step(w0)
+    # ~ulp template wobble (see module docstring) → allclose, not equal.
+    np.testing.assert_allclose(
+        chunked.residual(), mono.residual(), rtol=1e-4, atol=1e-5)
+    # A full-cube block has no accumulation reordering: bit-exact.
+    full = ChunkedJaxCleaner(D, w0, cfg, block=8, keep_residual=True)
+    full.step(w0)
+    np.testing.assert_array_equal(full.residual(), mono.residual())
+
+
+def test_chunk_block_subints_sizing(monkeypatch):
+    cfg = CleanConfig(backend="jax")
+    # Fits: no chunking.
+    monkeypatch.setenv("ICT_HBM_BYTES", str(1 << 40))
+    assert autoshard.chunk_block_subints((8, 16, 64), cfg) is None
+    # Unknown memory: no chunking.
+    monkeypatch.delenv("ICT_HBM_BYTES", raising=False)
+    if autoshard.device_memory_bytes() is None:
+        assert autoshard.chunk_block_subints((1 << 10,) * 3, cfg) is None
+    # Oversized: half the usable budget per slab, >= 1, <= nsub.
+    per_sub = autoshard.working_set_bytes((1, 16, 64))
+    monkeypatch.setenv("ICT_HBM_BYTES", str(per_sub * 8))
+    # usable = 7.2 slabs < the 8-slab cube -> chunk at 3.6/2... = 3 subints
+    assert autoshard.chunk_block_subints((8, 16, 64), cfg) == 3
+    monkeypatch.setenv("ICT_HBM_BYTES", "1024")
+    assert autoshard.chunk_block_subints((8, 16, 64), cfg) == 1
+
+
+class TestChunkedRouting:
+    """clean_cube must fall through to the chunked backend whenever the cube
+    is oversized but the sharded reroute declines."""
+
+    def test_single_device_routes_chunked(self, monkeypatch, capsys):
+        monkeypatch.setenv("ICT_HBM_BYTES", "4096")
+        import jax
+
+        monkeypatch.setattr(
+            autoshard, "default_devices", lambda: [jax.devices("cpu")[0]])
+        D, w0 = _cube(seed=83)
+        cfg = CleanConfig(backend="jax", max_iter=4)
+        res = clean_cube(D, w0, cfg)
+        assert "chunked clean" in capsys.readouterr().err
+        assert res.history and res.iterations  # stepwise path, full records
+        res_np = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=4))
+        np.testing.assert_array_equal(res.weights, res_np.weights)
+        assert res.loops == res_np.loops
+
+    def test_x64_cfg_doubles_itemsize(self, monkeypatch):
+        # Under --x64 the working-set estimate must count 8-byte elements:
+        # a cube that fits at f32 chunks at f64.
+        per_sub = autoshard.working_set_bytes((1, 16, 64))
+        usable = int(per_sub * 10 / autoshard.HBM_USABLE_FRACTION)
+        monkeypatch.setenv("ICT_HBM_BYTES", str(usable))
+        assert autoshard.chunk_block_subints(
+            (8, 16, 64), CleanConfig(backend="jax")) is None
+        assert autoshard.chunk_block_subints(
+            (8, 16, 64), CleanConfig(backend="jax", x64=True)) == 2
+
+    def test_x64_oversized_routes_chunked_subprocess(self, tmp_path):
+        """--x64 + oversized cube: sharding would drop f64, so the chunked
+        backend (which preserves it) must take the cube — in a fresh
+        interpreter where x64 can be enabled."""
+        import os
+        import subprocess
+        import sys
+
+        script = r"""
+import numpy as np
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.ops.preprocess import preprocess
+ar = make_archive(nsub=6, nchan=16, nbin=64, seed=87)
+D, w0 = preprocess(ar)
+res = clean_cube(D, w0, CleanConfig(backend="jax", max_iter=3, x64=True))
+assert res.history, "expected the stepwise chunked path"
+resnp = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=3))
+assert np.array_equal(res.weights, resnp.weights), "x64 chunked mask mismatch"
+print("X64-CHUNKED-OK")
+"""
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update({
+            "JAX_ENABLE_X64": "1",
+            "JAX_PLATFORMS": "cpu",
+            "ICT_HBM_BYTES": "4096",
+            "PYTHONPATH": os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))),
+        })
+        out = subprocess.run(
+            [sys.executable, "-c", script], env=env, capture_output=True,
+            text=True, timeout=300)
+        assert "X64-CHUNKED-OK" in out.stdout, out.stderr
+        assert "chunked clean" in out.stderr
+
+    def test_indivisible_dims_route_chunked(self, monkeypatch, capsys):
+        # nsub=3, nchan=5: no mesh axis divides either -> sharded declines.
+        monkeypatch.setenv("ICT_HBM_BYTES", "4096")
+        D, w0 = _cube(seed=84, nsub=3, nchan=5, nbin=64)
+        cfg = CleanConfig(backend="jax", max_iter=3)
+        res = clean_cube(D, w0, cfg)
+        err = capsys.readouterr().err
+        assert "no mesh axis divides" in err and "chunked clean" in err
+        res_np = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=3))
+        np.testing.assert_array_equal(res.weights, res_np.weights)
+
+    def test_residual_request_routes_chunked(self, monkeypatch, capsys):
+        monkeypatch.setenv("ICT_HBM_BYTES", "4096")
+        D, w0 = _cube(seed=85)
+        cfg = CleanConfig(backend="jax", max_iter=3)
+        res = clean_cube(D, w0, cfg, want_residual=True)
+        assert "chunked clean" in capsys.readouterr().err
+        assert res.residual is not None
+        res_mem = clean_cube(
+            D, w0, cfg.replace(auto_shard=False), want_residual=True)
+        np.testing.assert_array_equal(res.weights, res_mem.weights)
+        # residual: ~ulp template wobble from block-wise accumulation
+        np.testing.assert_allclose(
+            res.residual, res_mem.residual, rtol=1e-4, atol=1e-5)
+
+    def test_fused_falls_back_to_stepwise_chunked(self, monkeypatch, capsys):
+        monkeypatch.setenv("ICT_HBM_BYTES", "4096")
+        import jax
+
+        monkeypatch.setattr(
+            autoshard, "default_devices", lambda: [jax.devices("cpu")[0]])
+        D, w0 = _cube(seed=86)
+        cfg = CleanConfig(backend="jax", max_iter=3, fused=True)
+        res = clean_cube(D, w0, cfg)
+        assert "stepwise" in capsys.readouterr().err
+        res_np = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=3))
+        np.testing.assert_array_equal(res.weights, res_np.weights)

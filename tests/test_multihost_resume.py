@@ -32,6 +32,86 @@ class TestPartitionPaths:
         assert process_topology() == (0, 1)
 
 
+class TestRealTwoProcess:
+    """An actual ``jax.distributed`` 2-process run (VERDICT r02 ask #6):
+    ``process_topology() != (0, 1)`` genuinely executes — each process cleans
+    its round-robin slice and writes its own report suffix."""
+
+    SCRIPT = r"""
+import json, os, sys
+pid, port, out_dir = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+paths = sys.argv[4:]
+import jax
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+assert jax.process_index() == pid, jax.process_index()
+os.chdir(out_dir)
+from iterative_cleaner_tpu.cli import main
+rc = main(["--backend", "jax", "-q", "-l", "--report", "report.json"] + paths)
+from iterative_cleaner_tpu.parallel.multihost import partition_paths, process_topology
+assert process_topology() == (pid, 2)
+print("SLICE%d=%s" % (pid, json.dumps(partition_paths(paths))))
+sys.exit(rc)
+"""
+
+    def test_two_process_run(self, tmp_path):
+        import json
+        import os
+        import socket
+        import subprocess
+        import sys
+
+        paths = []
+        for i in range(3):
+            p = str(tmp_path / f"mh{i}.npz")
+            NpzIO().save(make_archive(nsub=4, nchan=16, nbin=64, seed=140 + i), p)
+            paths.append(p)
+
+        with socket.socket() as s:  # free port for the coordinator
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # no remote TPU hooks
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))),
+        })
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", self.SCRIPT, str(pid), str(port),
+                 str(tmp_path)] + paths,
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True)
+            for pid in range(2)
+        ]
+        outs = [p.communicate(timeout=600) for p in procs]
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, f"rc={p.returncode}\n{out}\n{err}"
+
+        # Disjoint round-robin slices covering the whole batch.
+        slices = []
+        for pid, (out, _err) in enumerate(outs):
+            line = [ln for ln in out.splitlines()
+                    if ln.startswith(f"SLICE{pid}=")][0]
+            slices.append(json.loads(line.split("=", 1)[1]))
+        assert slices[0] == [paths[0], paths[2]]
+        assert slices[1] == [paths[1]]
+
+        # Per-process report suffixes, no collisions, every archive cleaned.
+        for pid, sl in enumerate(slices):
+            rep_path = tmp_path / f"report.json.p{pid}"
+            assert rep_path.exists(), f"missing {rep_path}"
+            rep = json.loads(rep_path.read_text())
+            assert [r["path"] for r in rep] == sl
+            assert all(r["error"] is None for r in rep)
+        assert not (tmp_path / "report.json").exists()
+        for p in paths:
+            assert os.path.exists(p + "_cleaned.npz")
+
+
 class TestResume:
     def _write(self, tmp_path, n=3):
         paths = []

@@ -69,6 +69,40 @@ def test_sharded_batch_cli(three_npz, tmp_path, monkeypatch):
     assert log.count("Cleaned") == 2
 
 
+def test_fused_per_loop_observability(small_archive, capsys):
+    """--fused without -q prints the same per-loop diff/rfi_frac lines as the
+    stepwise path (reference iterative_cleaner.py:132-133), derived post hoc
+    from the on-device history ring buffer."""
+    D, w0 = preprocess(small_archive)
+    res_step = clean_cube(D, w0, CleanConfig(backend="jax", max_iter=5))
+    seen = []
+    res_fused = clean_cube(
+        D, w0, CleanConfig(backend="jax", max_iter=5, fused=True),
+        progress=seen.append)
+    assert len(res_fused.iterations) == len(res_step.iterations)
+    assert seen == res_fused.iterations
+    for a, b in zip(res_fused.iterations, res_step.iterations):
+        assert (a.index, a.diff_weights, a.rfi_frac) == (
+            b.index, b.diff_weights, b.rfi_frac)
+
+
+def test_fused_cli_prints_loop_lines(three_npz, tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    rc = main(["--backend", "jax", "--fused", "-l", three_npz[0]])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Loop: 1" in out
+    assert "Differences to previous weights:" in out
+
+
+def test_sharded_batch_dump_masks_warns(three_npz, tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    rc = main(["--sharded_batch", "--backend", "jax", "-q", "-l",
+               "--dump_masks", three_npz[1]])
+    assert rc == 0
+    assert "without the 'history' key" in capsys.readouterr().err
+
+
 def test_sharded_batch_dump_masks_omits_history(three_npz, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     rc = main(["--sharded_batch", "--backend", "jax", "-q", "-l",

@@ -1,0 +1,105 @@
+"""PSRCHIVE golden-fixture tests (VERDICT r02 ask #5).
+
+``tests/fixtures/psrchive_golden.npz`` freezes (a) our preprocess's cube and
+the numpy oracle's flag mask for the standard synthetic archive, and (b) the
+cube + mask from an independent emulation of PSRCHIVE's documented
+preprocessing semantics (per-profile minimum-window baseline BEFORE
+dedispersion, exact fractional-bin Fourier rotation — the behaviors
+``ops/preprocess.py`` documents as divergences; reference
+iterative_cleaner.py:88-99).  Generator: ``tools/make_psrchive_golden.py``.
+
+These tests fail on semantic drift of preprocess or the stats pipeline, and
+pin the measured mask IoU across the documented divergences (1.0 at
+generation time — the §8.L8 shift-invariance claim, quantified).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "psrchive_golden.npz")
+
+
+@pytest.fixture(scope="module")
+def golden():
+    with np.load(FIXTURE) as z:
+        return {k: z[k] for k in z.files}
+
+
+@pytest.fixture(scope="module")
+def archive(golden):
+    return make_archive(nsub=int(golden["nsub"]), nchan=int(golden["nchan"]),
+                        nbin=int(golden["nbin"]), seed=int(golden["seed"]))
+
+
+def test_preprocess_matches_golden_bitwise(golden, archive):
+    """Semantic drift detector: our preprocess must still produce the exact
+    cube it produced when the golden was generated."""
+    D, w0 = preprocess(archive, prefer_native=False)
+    np.testing.assert_array_equal(w0, golden["w0"])
+    np.testing.assert_array_equal(D, golden["D_ours"])
+
+
+def test_native_preprocess_matches_golden(golden, archive):
+    """The C++ host runtime (when built) is pinned to the same golden."""
+    from iterative_cleaner_tpu import native
+
+    if not native.available():
+        pytest.skip("native runtime not built")
+    out = native.preprocess_native(archive)
+    if out is None:
+        pytest.skip("native preprocess declined this archive")
+    D, w0 = out
+    np.testing.assert_array_equal(D, golden["D_ours"])
+
+
+def test_oracle_mask_matches_golden(golden):
+    """Stats-pipeline drift detector: cleaning the frozen cube must still
+    produce the frozen mask."""
+    res = clean_cube(
+        np.asarray(golden["D_ours"]), np.asarray(golden["w0"]),
+        CleanConfig(backend="numpy", max_iter=int(golden["max_iter"])))
+    np.testing.assert_array_equal(res.weights, golden["mask_ours"])
+
+
+def test_psrchive_emulated_cube_mask_matches_golden(golden):
+    res = clean_cube(
+        np.asarray(golden["D_psrchive_emulated"]), np.asarray(golden["w0"]),
+        CleanConfig(backend="numpy", max_iter=int(golden["max_iter"])))
+    np.testing.assert_array_equal(res.weights, golden["mask_psrchive"])
+
+
+def test_mask_iou_across_documented_divergences(golden):
+    """The quantified claim: integer-bin rotation + post-dedisperse global
+    baseline window (ours) vs exact rotation + per-profile pre-dedisperse
+    baseline (PSRCHIVE semantics) produce identical flag masks (IoU == 1.0
+    at generation; any regression below the stored value is drift)."""
+    za = np.asarray(golden["mask_ours"]) == 0
+    zb = np.asarray(golden["mask_psrchive"]) == 0
+    union = np.logical_or(za, zb).sum()
+    iou = 1.0 if union == 0 else float(np.logical_and(za, zb).sum() / union)
+    assert iou == pytest.approx(float(golden["iou"]))
+    assert iou >= 0.95  # the emulated-PSRCHIVE world must stay mask-compatible
+
+
+def test_regenerated_emulation_matches_golden(archive, golden):
+    """The generator itself is deterministic: re-emulating PSRCHIVE
+    preprocessing reproduces the stored cube bit-for-bit."""
+    import importlib.util
+    import sys
+
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "make_psrchive_golden.py")
+    spec = importlib.util.spec_from_file_location("make_psrchive_golden", tool)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    D_psr = mod.emulate_psrchive_preprocess(archive)
+    np.testing.assert_array_equal(D_psr, golden["D_psrchive_emulated"])

@@ -1,0 +1,126 @@
+"""Generate tests/fixtures/psrchive_golden.npz.
+
+The reference's entire preprocessing world is PSRCHIVE C++
+(``/root/reference/iterative_cleaner.py:88-99``: pscrunch →
+remove_baseline → dedisperse on every iteration's clone).  The real library
+(Python-2-era SWIG bindings) is unavailable in this hermetic environment, so
+this script builds the golden from an *independent emulation of PSRCHIVE's
+documented algorithms* — deliberately implementing the exact behaviors our
+production preprocess (:mod:`iterative_cleaner_tpu.ops.preprocess`)
+documents as divergences:
+
+- baseline removal BEFORE dedispersion (the reference's call order, :88-90),
+  with a PER-PROFILE minimum-running-mean window (PSRCHIVE's default
+  "minimum" baseline estimator works per profile) — ours uses one global
+  window from the weighted total profile, after dedispersion;
+- EXACT fractional-bin dedispersion via Fourier phase rotation (PSRCHIVE
+  rotates profiles by exact time shifts) — ours rounds to integer bins.
+
+The fixture freezes: the emulated cube, our preprocess's cube, and the flag
+masks the numpy oracle produces from each — so ``tests/test_psrchive_golden.py``
+both *fails on semantic drift* of our preprocess/stats and *quantifies* the
+documented divergences as a mask IoU (SURVEY.md §8.L8 claims shift-invariance
+makes them mask-equivalent; the stored IoU is the measured truth).
+
+Run from the repo root: ``python tools/make_psrchive_golden.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.ops.preprocess import (
+    BASELINE_FRAC,
+    DM_CONST,
+    preprocess,
+    pscrunch,
+)
+
+NSUB, NCHAN, NBIN, SEED = 8, 64, 256, 42
+MAX_ITER = 5
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "fixtures", "psrchive_golden.npz")
+
+
+def per_profile_min_window_baseline(cube: np.ndarray, frac: float = BASELINE_FRAC) -> np.ndarray:
+    """PSRCHIVE-style per-profile baseline: subtract the mean of each
+    profile's own circular minimum-running-mean window."""
+    nbin = cube.shape[-1]
+    width = max(1, int(round(frac * nbin)))
+    ext = np.concatenate([cube, cube[..., :width]], axis=-1).astype(np.float64)
+    csum = np.cumsum(ext, axis=-1)
+    csum = np.concatenate([np.zeros_like(csum[..., :1]), csum], axis=-1)
+    means = (csum[..., width:width + nbin] - csum[..., :nbin]) / width
+    base = np.min(means, axis=-1, keepdims=True)
+    return (cube.astype(np.float64) - base).astype(np.float32)
+
+
+def exact_phase_dedisperse(
+    cube: np.ndarray, freqs: np.ndarray, dm: float, period: float,
+    ref_freq: float,
+) -> np.ndarray:
+    """Fractional-bin dedispersion by Fourier phase rotation (the exact time
+    shift PSRCHIVE applies, vs our integer-bin roll)."""
+    nbin = cube.shape[-1]
+    delay = DM_CONST * dm * (np.asarray(freqs, np.float64) ** -2
+                             - float(ref_freq) ** -2)
+    shift_bins = delay / period * nbin  # forward rotation, like roll_cube
+    k = np.arange(nbin // 2 + 1)
+    phase = np.exp(2j * np.pi * k[None, :] * (shift_bins[:, None] / nbin))
+    spec = np.fft.rfft(cube.astype(np.float64), axis=-1)
+    return np.fft.irfft(spec * phase, n=nbin, axis=-1).astype(np.float32)
+
+
+def emulate_psrchive_preprocess(archive) -> np.ndarray:
+    cube = pscrunch(archive.data, archive.state).astype(np.float32)
+    cube = per_profile_min_window_baseline(cube)          # :89, pre-dedisperse
+    if not archive.dedispersed:
+        cube = exact_phase_dedisperse(
+            cube, archive.freqs, archive.dm, archive.period,
+            archive.centre_frequency)                     # :90, exact phase
+    return cube
+
+
+def zap_iou(wa: np.ndarray, wb: np.ndarray) -> float:
+    za, zb = wa == 0, wb == 0
+    union = np.logical_or(za, zb).sum()
+    if union == 0:
+        return 1.0
+    return float(np.logical_and(za, zb).sum() / union)
+
+
+def main() -> None:
+    ar = make_archive(nsub=NSUB, nchan=NCHAN, nbin=NBIN, seed=SEED)
+    D_ours, w0 = preprocess(ar, prefer_native=False)
+    D_psr = emulate_psrchive_preprocess(ar)
+
+    cfg = CleanConfig(backend="numpy", max_iter=MAX_ITER)
+    res_ours = clean_cube(D_ours, w0, cfg)
+    res_psr = clean_cube(D_psr, w0, cfg)
+    iou = zap_iou(res_ours.weights, res_psr.weights)
+    print(f"ours: loops={res_ours.loops} zapped={(res_ours.weights == 0).sum()}")
+    print(f"psr : loops={res_psr.loops} zapped={(res_psr.weights == 0).sum()}")
+    print(f"mask IoU (documented preprocess divergences): {iou}")
+
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    np.savez_compressed(
+        OUT,
+        nsub=NSUB, nchan=NCHAN, nbin=NBIN, seed=SEED, max_iter=MAX_ITER,
+        D_ours=D_ours, D_psrchive_emulated=D_psr, w0=w0,
+        mask_ours=res_ours.weights, mask_psrchive=res_psr.weights,
+        iou=iou,
+    )
+    print(f"wrote {OUT} ({os.path.getsize(OUT) / 1e6:.2f} MB)")
+
+
+if __name__ == "__main__":
+    main()
