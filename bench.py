@@ -246,16 +246,22 @@ def _bench_config(tag, nsub, nchan, nbin, *, full_numpy, dev):
         end_to_end_speedup=round(numpy_e2e / jax_e2e_cold, 2),
         end_to_end_speedup_warm=round(numpy_e2e / jax_e2e_warm, 2),
         per_iteration_speedup=round(t_numpy_step / t_jax_step, 1),
-        # Projection: same measured compute, real-host PCIe for the upload.
-        end_to_end_speedup_projected_real_host=round(
+        # Projections substitute ONLY the upload constant (real-host PCIe
+        # instead of the dev tunnel); measured compute times are untouched —
+        # the cold variant keeps the full measured compile+run, the warm
+        # variant is compile-amortised.
+        end_to_end_speedup_projected_real_host_cold=round(
+            numpy_e2e / (t_upload_proj + t_cold), 1),
+        end_to_end_speedup_projected_real_host_warm=round(
             numpy_e2e / (t_upload_proj + t_warm), 1),
         projection_assumes_pcie_gbps=REAL_HOST_PCIE_GBPS,
     )
     log(f"[{tag}] end-to-end speedup: {out['end_to_end_speedup']}x cold, "
         f"{out['end_to_end_speedup_warm']}x warm, "
-        f"{out['per_iteration_speedup']}x per-iteration, "
-        f"{out['end_to_end_speedup_projected_real_host']}x projected on a "
-        f"{REAL_HOST_PCIE_GBPS:.0f} GB/s host link")
+        f"{out['per_iteration_speedup']}x per-iteration; projected on a "
+        f"{REAL_HOST_PCIE_GBPS:.0f} GB/s host link: "
+        f"{out['end_to_end_speedup_projected_real_host_cold']}x cold / "
+        f"{out['end_to_end_speedup_projected_real_host_warm']}x warm")
 
     # --- device memory peak (validates autoshard.PEAK_CUBE_FACTOR) ---
     try:
@@ -462,7 +468,8 @@ def run_bench() -> dict:
     # Promote config A's headline numbers to the top level.
     for k in ("end_to_end_speedup", "end_to_end_speedup_warm",
               "per_iteration_speedup",
-              "end_to_end_speedup_projected_real_host",
+              "end_to_end_speedup_projected_real_host_cold",
+              "end_to_end_speedup_projected_real_host_warm",
               "numpy_e2e_s", "jax_e2e_cold_s", "jax_e2e_warm_s",
               "upload_s", "iterations", "parity_iter1"):
         if k in out_a:
@@ -501,7 +508,8 @@ def run_bench() -> dict:
 
     _PAYLOAD["tunnel_note"] = (
         "upload runs through a dev tunnel at ~tens of MB/s; a real TPU host "
-        "moves GB/s over PCIe — see end_to_end_speedup_projected_real_host")
+        "moves GB/s over PCIe — see the "
+        "end_to_end_speedup_projected_real_host_{cold,warm} keys")
     return _PAYLOAD
 
 

@@ -6,7 +6,9 @@ reference's PSRCHIVE API surface (SURVEY.md §2.3): load/unload, data + weight
 extraction, metadata for output naming, and weight write-back on save.
 
 This module is import-safe without psrchive; constructing :class:`PsrchiveIO`
-raises a clear error instead.
+raises a clear error instead.  The backend logic itself is exercised
+hermetically by ``tests/test_psrchive_io.py`` against
+``tests/fake_psrchive.py``, which implements exactly this object surface.
 """
 
 from __future__ import annotations
@@ -30,7 +32,7 @@ def psrchive_available() -> bool:
     return _psr is not None
 
 
-class PsrchiveIO:  # pragma: no cover - exercised only with real psrchive
+class PsrchiveIO:
     def __init__(self) -> None:
         if _psr is None:
             raise ImportError(

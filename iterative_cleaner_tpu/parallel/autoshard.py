@@ -184,11 +184,10 @@ def maybe_clean_sharded(D, w0, cfg, want_residual: bool):
     mesh = single_archive_mesh(D.shape)
     gb = working_set_bytes(D.shape, itemsize) / 1e9
     if mesh.devices.size == 1:
-        print(
-            f"note: cube {tuple(D.shape)} (~{gb:.1f} GB working set) exceeds "
-            "device memory but no mesh axis divides its dims; using the "
-            "single-device chunked path",
-            file=sys.stderr)
+        # No mesh axis divides the cube's dims: decline silently — the
+        # chunked route picks it up and prints the one authoritative
+        # "chunked clean" announcement (a second note here would just
+        # double the routing noise per archive).
         return None
     notes = "no per-loop progress; disable with auto_shard=False"
     if cfg.pallas:

@@ -82,10 +82,13 @@ class ChunkedJaxCleaner:
 
     ``block`` is the subint slab size (from
     :func:`..parallel.autoshard.chunk_block_subints` when routed
-    automatically).  ``keep_residual`` assembles the last step's residual
-    cube in host RAM (cube-sized *host* memory — the whole point is that it
-    does not fit the device), enabling --unload_res at >HBM scale, at the
-    price of one cube download per iteration.
+    automatically).  ``keep_residual`` enables ``residual()`` — the last
+    step's residual cube assembled in host RAM (cube-sized *host* memory;
+    the whole point is that it does not fit the device) for --unload_res at
+    >HBM scale.  It is computed LAZILY on first ``residual()`` call by
+    re-running the two passes for the last step's weights: one extra cube
+    upload pass once, instead of a cube download on every iteration for a
+    value only the final iteration ever uses.
     """
 
     def __init__(
@@ -108,11 +111,8 @@ class ChunkedJaxCleaner:
         self._w0 = jax.device_put(jnp.asarray(w0, self._dtype))
         self._valid = self._w0 != 0
         self._keep_residual = keep_residual
-        # Host residual buffer keeps the compute dtype: under --x64 the
-        # in-memory JaxCleaner returns an f64 residual, and so must we.
-        res_dtype = np.float64 if cfg.x64 else np.float32
-        self._residual = (
-            np.empty(self._D.shape, res_dtype) if keep_residual else None)
+        self._resid_w_prev: np.ndarray | None = None  # last step's weights
+        self._residual: np.ndarray | None = None      # lazily-filled cache
 
     def _blocks(self):
         nsub = self._D.shape[0]
@@ -135,12 +135,9 @@ class ChunkedJaxCleaner:
         """
         np.asarray(x[(0,) * x.ndim])
 
-    def step(self, w_prev: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        w_prev = jnp.asarray(w_prev, self._dtype)
-        nbin = self._D.shape[-1]
-
-        # Pass 1: template accumulation (device-resident (nbin,) accumulator).
-        template = jnp.zeros(nbin, self._dtype)
+    def _template(self, w_prev) -> jnp.ndarray:
+        """Pass 1: template accumulation (device-resident accumulator)."""
+        template = jnp.zeros(self._D.shape[-1], self._dtype)
         prev = None
         for lo, hi in self._blocks():
             Dblk = jnp.asarray(self._D[lo:hi], self._dtype)
@@ -150,6 +147,17 @@ class ChunkedJaxCleaner:
                 self._sync(prev)
             prev = before
         self._sync(template)
+        return template
+
+    def step(self, w_prev: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        if self._keep_residual:
+            # residual() recomputes from these weights on demand — a cube
+            # download per iteration for a value only the last iteration
+            # uses would be pure waste.
+            self._resid_w_prev = np.asarray(w_prev)
+            self._residual = None
+        w_prev = jnp.asarray(w_prev, self._dtype)
+        template = self._template(w_prev)
 
         # Pass 2: per-block fit + diagnostics; maps accumulate on device.
         maps: list[tuple] = []
@@ -159,14 +167,9 @@ class ChunkedJaxCleaner:
             out = _block_stats(
                 Dblk, template, self._w0[lo:hi], self._valid[lo:hi],
                 pulse_region=tuple(self.cfg.pulse_region),
-                want_resid=self._keep_residual,
+                want_resid=False,
             )
-            if self._keep_residual:
-                # Fetching the cube-sized residual block synchronises and
-                # frees it in one go.
-                self._residual[lo:hi] = np.asarray(
-                    out[4], self._residual.dtype)
-            elif prev is not None:
+            if prev is not None:
                 self._sync(prev[0])
             prev = out
             maps.append(out[:4])
@@ -182,4 +185,24 @@ class ChunkedJaxCleaner:
         return np.asarray(test), np.asarray(new_w)
 
     def residual(self) -> np.ndarray | None:
+        """The last step's residual, recomputed lazily (see class docstring).
+
+        Keeps the compute dtype: under --x64 the in-memory JaxCleaner
+        returns an f64 residual, and so does this."""
+        if not self._keep_residual or self._resid_w_prev is None:
+            return None
+        if self._residual is None:
+            template = self._template(
+                jnp.asarray(self._resid_w_prev, self._dtype))
+            res_dtype = np.float64 if self.cfg.x64 else np.float32
+            self._residual = np.empty(self._D.shape, res_dtype)
+            for lo, hi in self._blocks():
+                Dblk = jnp.asarray(self._D[lo:hi], self._dtype)
+                out = _block_stats(
+                    Dblk, template, self._w0[lo:hi], self._valid[lo:hi],
+                    pulse_region=tuple(self.cfg.pulse_region),
+                    want_resid=True,
+                )
+                # Fetching the cube-sized block synchronises + frees it.
+                self._residual[lo:hi] = np.asarray(out[4], res_dtype)
         return self._residual

@@ -1,0 +1,145 @@
+"""A hermetic stand-in for the psrchive SWIG bindings.
+
+Implements exactly the object surface :mod:`iterative_cleaner_tpu.io.
+psrchive_io` touches (a subset of the reference's 22-method contract,
+SURVEY.md §2.3): ``Archive_load``, ``get_data``/``get_weights``/dims,
+per-channel ``Integration.get_centre_frequency``, ``get_state``/``get_npol``,
+fold metadata, ``start_time().strtempo()``, ``get_Profile(...).get_amps()``
+as a *mutable view* (the reference writes residuals through it —
+iterative_cleaner.py:271), ``Integration.set_weight``, ``pscrunch`` and
+``unload``.
+
+The "file format" is an NPZ under the hood, written to the real path given
+to ``unload`` — so the driver's atomic write-then-rename and --resume
+existence checks behave exactly as with real files.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_FIELDS = ("data", "weights", "freqs", "centre_frequency", "dm", "period",
+           "source", "mjd_start", "mjd_end", "state", "dedispersed")
+
+
+def write_fake_ar(path: str, *, data, weights, freqs, centre_frequency, dm,
+                  period, source, mjd_start, mjd_end, state,
+                  dedispersed) -> None:
+    """Author a fake .ar file (NPZ payload) directly.  Written through a
+    file object so the exact path is honoured (np.savez would append .npz)."""
+    with open(path, "wb") as fh:
+        np.savez(fh, data=data, weights=weights, freqs=freqs,
+                 centre_frequency=centre_frequency, dm=dm, period=period,
+                 source=source, mjd_start=mjd_start, mjd_end=mjd_end,
+                 state=state, dedispersed=dedispersed)
+
+
+class _Time:
+    def __init__(self, mjd: float) -> None:
+        self._mjd = float(mjd)
+
+    def strtempo(self) -> str:
+        return repr(self._mjd)
+
+
+class _Profile:
+    def __init__(self, amps_view: np.ndarray) -> None:
+        self._amps = amps_view  # mutable view into the archive cube
+
+    def get_amps(self) -> np.ndarray:
+        return self._amps
+
+
+class _Integration:
+    def __init__(self, ar: "FakeArchive", isub: int) -> None:
+        self._ar, self._isub = ar, isub
+
+    def get_centre_frequency(self, ichan: int) -> float:
+        return float(self._ar._freqs[ichan])
+
+    def get_folding_period(self) -> float:
+        return float(self._ar._period)
+
+    def set_weight(self, ichan: int, w: float) -> None:
+        self._ar._weights[self._isub, ichan] = w
+
+
+class FakeArchive:
+    def __init__(self, path: str) -> None:
+        with np.load(path) as z:
+            self._data = np.array(z["data"], dtype=np.float32)
+            self._weights = np.array(z["weights"], dtype=np.float32)
+            self._freqs = np.array(z["freqs"], dtype=np.float64)
+            self._cfreq = float(z["centre_frequency"])
+            self._dm = float(z["dm"])
+            self._period = float(z["period"])
+            self._source = str(z["source"])
+            self._mjd_start = float(z["mjd_start"])
+            self._mjd_end = float(z["mjd_end"])
+            self._state = str(z["state"])
+            self._dedispersed = bool(z["dedispersed"])
+
+    # --- dims / metadata ---
+    def get_data(self) -> np.ndarray:
+        return self._data.copy()  # psrchive returns a fresh cube
+
+    def get_weights(self) -> np.ndarray:
+        return self._weights.copy()
+
+    def get_nchan(self) -> int:
+        return self._data.shape[2]
+
+    def get_npol(self) -> int:
+        return self._data.shape[1]
+
+    def get_state(self) -> str:
+        return self._state
+
+    def get_centre_frequency(self) -> float:
+        return self._cfreq
+
+    def get_dispersion_measure(self) -> float:
+        return self._dm
+
+    def get_source(self) -> str:
+        return self._source
+
+    def get_dedispersed(self) -> bool:
+        return self._dedispersed
+
+    def start_time(self) -> _Time:
+        return _Time(self._mjd_start)
+
+    def end_time(self) -> _Time:
+        return _Time(self._mjd_end)
+
+    # --- object model ---
+    def get_Integration(self, isub: int) -> _Integration:
+        return _Integration(self, isub)
+
+    def get_Profile(self, isub: int, ipol: int, ichan: int) -> _Profile:
+        return _Profile(self._data[isub, ipol, ichan])
+
+    # --- mutation / output ---
+    def pscrunch(self) -> None:
+        if self._data.shape[1] == 1:
+            self._state = "Intensity"
+            return
+        if self._state == "Coherence":
+            total = self._data[:, 0] + self._data[:, 1]
+        else:  # Stokes: total intensity is pol 0
+            total = self._data[:, 0]
+        self._data = np.ascontiguousarray(total[:, None])
+        self._state = "Intensity"
+
+    def unload(self, path: str) -> None:
+        write_fake_ar(
+            path, data=self._data, weights=self._weights, freqs=self._freqs,
+            centre_frequency=self._cfreq, dm=self._dm, period=self._period,
+            source=self._source, mjd_start=self._mjd_start,
+            mjd_end=self._mjd_end, state=self._state,
+            dedispersed=self._dedispersed)
+
+
+def Archive_load(path: str) -> FakeArchive:  # noqa: N802 — SWIG-style name
+    return FakeArchive(path)
