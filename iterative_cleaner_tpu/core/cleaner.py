@@ -114,9 +114,6 @@ def clean_cube(
             notes = []
             if cfg.fused:
                 notes.append("fused loop runs stepwise on this path")
-            if cfg.pallas:
-                notes.append("pallas unavailable on this path, using the "
-                             "XLA kernels")
             if cfg.x64:
                 notes.append("x64: block-wise template accumulation "
                              "reorders the f64 sum, so bit-identity of "

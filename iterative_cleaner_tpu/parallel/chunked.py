@@ -22,7 +22,11 @@ in-memory path so the semantics cannot drift:
    (:func:`..ops.template.fit_and_subtract`), weight pre-scaling, and the
    four per-profile diagnostics (:func:`..ops.stats.diagnostics`) — all
    per-profile math, bit-identical to the in-memory path.  Only the tiny
-   (nsub, nchan) diagnostic maps stay device-resident.
+   (nsub, nchan) diagnostic maps stay device-resident.  Under
+   ``cfg.pallas`` the fit/weight/centre/moment part of this pass runs the
+   fused Pallas kernel per block (one HBM pass over the block —
+   :mod:`..ops.pallas_kernels`), exactly as the in-memory ``clean_step``
+   does.
 
 The cross-profile couplings (per-channel / per-subint robust scalers) run
 once on the assembled maps — three orders of magnitude smaller than the cube.
@@ -66,6 +70,22 @@ def _block_stats(Dblk, template, w0blk, validblk, *, pulse_region, want_resid):
     if want_resid:
         return d_std, d_mean, d_ptp, d_fft, resid
     return d_std, d_mean, d_ptp, d_fft, None
+
+
+@partial(jax.jit, static_argnames=("pulse_region", "interpret"))
+def _block_stats_pallas(Dblk, template, w0blk, validblk, *, pulse_region,
+                        interpret):
+    """The Pallas route for one block: the fused fit/weight/centre/moments
+    kernel (one HBM pass over the block — ops/pallas_kernels.py), then the
+    XLA FFT diagnostic and the numpy.ma fills."""
+    from iterative_cleaner_tpu.ops.pallas_kernels import fused_fit_moments
+    from iterative_cleaner_tpu.ops.stats import fft_diagnostic, fill_moments
+
+    centred, mean, std, ptp = fused_fit_moments(
+        Dblk, template, w0blk, pulse_region=pulse_region,
+        interpret=interpret)
+    d_mean, d_std, d_ptp = fill_moments(mean, std, ptp, validblk)
+    return d_std, d_mean, d_ptp, fft_diagnostic(centred)
 
 
 @jax.jit
@@ -113,6 +133,20 @@ class ChunkedJaxCleaner:
         self._keep_residual = keep_residual
         self._resid_w_prev: np.ndarray | None = None  # last step's weights
         self._residual: np.ndarray | None = None      # lazily-filled cache
+        self._use_pallas = False
+        if cfg.pallas:
+            from iterative_cleaner_tpu.ops.pallas_kernels import (
+                pallas_route_ok,
+            )
+
+            self._use_pallas = pallas_route_ok(self._D.shape[-1])
+            if not self._use_pallas:
+                import warnings
+
+                warnings.warn(
+                    "pallas=True but the Pallas route is not viable here "
+                    "(non-TPU platform or nbin too large for VMEM); the "
+                    "chunked backend uses the XLA route", stacklevel=2)
 
     def _blocks(self):
         nsub = self._D.shape[0]
@@ -160,15 +194,26 @@ class ChunkedJaxCleaner:
         template = self._template(w_prev)
 
         # Pass 2: per-block fit + diagnostics; maps accumulate on device.
+        if self._use_pallas:
+            from iterative_cleaner_tpu.ops.pallas_kernels import use_interpret
+
+            interp = use_interpret()
         maps: list[tuple] = []
         prev = None
         for lo, hi in self._blocks():
             Dblk = jnp.asarray(self._D[lo:hi], self._dtype)
-            out = _block_stats(
-                Dblk, template, self._w0[lo:hi], self._valid[lo:hi],
-                pulse_region=tuple(self.cfg.pulse_region),
-                want_resid=False,
-            )
+            if self._use_pallas:
+                out = _block_stats_pallas(
+                    Dblk, template, self._w0[lo:hi], self._valid[lo:hi],
+                    pulse_region=tuple(self.cfg.pulse_region),
+                    interpret=interp,
+                )
+            else:
+                out = _block_stats(
+                    Dblk, template, self._w0[lo:hi], self._valid[lo:hi],
+                    pulse_region=tuple(self.cfg.pulse_region),
+                    want_resid=False,
+                )
             if prev is not None:
                 self._sync(prev[0])
             prev = out

@@ -11,7 +11,10 @@ For a cube too big even for one *host's* chips, the global mesh from
 ``jax.distributed.initialize`` + ``make_mesh`` spans hosts and the sp/tp
 collectives ride DCN; that path works unchanged through
 ``parallel.sharded`` because GSPMD is topology-agnostic — it is just slower,
-and the autoshard router never picks it spontaneously.
+and the autoshard router never picks it spontaneously.  Proven end to end by
+``tests/test_multihost_resume.py::TestGlobalMeshTwoProcess``: two real
+processes, one (sp=4, tp=2) mesh across them, oracle-exact masks on both
+hosts (``sharded._to_host`` all-gathers the process-spanning outputs).
 """
 
 from __future__ import annotations

@@ -77,6 +77,25 @@ def sharded_clean_single(D: np.ndarray, w0: np.ndarray, cfg: CleanConfig, mesh: 
     return test[0], w[0], int(loops[0]), bool(done[0])
 
 
+def _to_host(*xs) -> tuple[np.ndarray, ...]:
+    """Host values of possibly process-spanning global arrays.
+
+    On a mesh confined to this process a plain fetch works; on a global
+    mesh from ``jax.distributed`` (the multi-host DCN path,
+    :mod:`.multihost`) the outputs' shards live on other processes'
+    devices, so every process all-gathers the global values — each host
+    needs the full mask to write its outputs.  One pytree allgather for
+    all outputs (they share a mesh, hence addressability), not one
+    blocking DCN round per array.
+    """
+    if all(x.is_fully_addressable for x in xs):
+        return tuple(np.asarray(x) for x in xs)
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(tuple(xs), tiled=True)
+    return tuple(np.asarray(g) for g in gathered)
+
+
 def sharded_clean(
     Db: np.ndarray,
     w0b: np.ndarray,
@@ -86,7 +105,9 @@ def sharded_clean(
     """Clean a same-shape batch of preprocessed cubes on a device mesh.
 
     Returns host arrays: (test (a,s,c), weights (a,s,c), loops (a,),
-    converged (a,)).
+    converged (a,)).  The mesh may span processes (multi-controller SPMD):
+    every participating process must call this with the same batch, and
+    each gets the full host-side result back.
     """
     Db, w0b = shard_batch(Db, w0b, mesh)
     validb = w0b != 0
@@ -99,9 +120,4 @@ def sharded_clean(
         max_iter=int(cfg.max_iter),
         pulse_region=tuple(cfg.pulse_region),
     )
-    return (
-        np.asarray(test),
-        np.asarray(w_final),
-        np.asarray(loops),
-        np.asarray(done),
-    )
+    return _to_host(test, w_final, loops, done)

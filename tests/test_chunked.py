@@ -38,6 +38,22 @@ def test_chunked_step_matches_in_memory(block):
         np.testing.assert_array_equal(test_c, test_m)
 
 
+@pytest.mark.parametrize("block", [3, 8])
+def test_chunked_pallas_matches_oracle(block):
+    """The per-block Pallas route (interpret mode on CPU) produces the same
+    masks as the numpy oracle and the XLA chunked route."""
+    D, w0 = _cube(seed=88)
+    cfg = CleanConfig(backend="jax", pallas=True)
+    _t, w_p = ChunkedJaxCleaner(D, w0, cfg, block=block).step(w0)
+    _t, w_x = ChunkedJaxCleaner(
+        D, w0, cfg.replace(pallas=False), block=block).step(w0)
+    np.testing.assert_array_equal(w_p, w_x)
+    from iterative_cleaner_tpu.backends.numpy_backend import NumpyCleaner
+
+    _t, w_np = NumpyCleaner(D, w0, CleanConfig(backend="numpy")).step(w0)
+    np.testing.assert_array_equal(w_p, w_np)
+
+
 def test_chunked_full_loop_matches_numpy_oracle():
     D, w0 = _cube(seed=81)
     cfg = CleanConfig(backend="jax", max_iter=4)
