@@ -435,9 +435,14 @@ def _bench_chunked(state, upload_gbps: float) -> dict:
     hbm = autoshard.device_memory_bytes()
     mode = os.environ.get("BENCH_CHUNKED_FULL", "auto")
     ram = _host_ram_bytes()
+    # Host peak while synthesizing the >HBM cube: make_archive builds the
+    # cube in float64 (2x f32 bytes) then casts a float32 copy (+1x), and
+    # preprocess holds data + output (~2x) after — ~3.5x the f32 cube, plus
+    # slack for the process and the still-live config-A state.
+    ram_needed = None if hbm is None else 3.5 * hbm * 1.06 + 8e9
     can_full = (hbm is not None
                 and upload_gbps >= 1.0
-                and ram > 2.5 * hbm * 1.06 + 8e9)
+                and ram > ram_needed)
     want_full = mode == "1" or (mode == "auto" and can_full)
 
     if want_full:
@@ -456,10 +461,10 @@ def _bench_chunked(state, upload_gbps: float) -> dict:
         Dbig, w0big = preprocess(big)
         del big
         t_gen = time.time() - t0
-        block = autoshard.chunk_block_subints(Dbig.shape,
-                                              CleanConfig(backend="jax"))
+        block = autoshard.chunk_block_subints(
+            Dbig.shape, CleanConfig(backend="jax")) or 64
         backend = ChunkedJaxCleaner(
-            Dbig, w0big, CleanConfig(backend="jax"), block=block or 64)
+            Dbig, w0big, CleanConfig(backend="jax"), block=block)
         t0 = time.time()
         _test, w1 = backend.step(w0big)
         t_first = time.time() - t0
@@ -498,8 +503,9 @@ def _bench_chunked(state, upload_gbps: float) -> dict:
     if upload_gbps < 1.0:
         reasons.append(f"upload link too slow ({upload_gbps * 1e3:.0f} MB/s; "
                        "a >HBM cube would take hours)")
-    if hbm is not None and not ram > 2.5 * hbm * 1.06 + 8e9:
-        reasons.append(f"host RAM too small ({ram / 1e9:.0f} GB)")
+    if ram_needed is not None and not ram > ram_needed:
+        reasons.append(f"host RAM too small ({ram / 1e9:.0f} GB < "
+                       f"{ram_needed / 1e9:.0f} GB needed)")
     res = {
         "mode": "forced_blocks_at_config_a",
         "why_not_full": "; ".join(reasons) or "unspecified",

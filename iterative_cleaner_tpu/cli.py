@@ -92,6 +92,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="jax: never shard an oversized cube over the device "
                         "mesh (default: cubes whose working set exceeds one "
                         "chip's HBM are cleaned sharded when more chips exist)")
+    p.add_argument("--chunk_block", type=int, default=0, metavar="N",
+                   help="jax: force the single-device streaming backend with "
+                        "N-subint blocks, regardless of the device-memory "
+                        "estimate (0 = automatic; the escape hatch when the "
+                        "working-set estimate or reported HBM is off)")
     p.add_argument("--dump_masks", action="store_true",
                    help="save the final mask (plus per-iteration history in "
                         "stepwise mode) as <output>_masks.npz")
@@ -133,6 +138,7 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         x64=args.x64,
         sharded_batch=args.sharded_batch,
         auto_shard=not args.no_auto_shard,
+        chunk_block=args.chunk_block,
         stream=args.stream,
         resume=args.resume,
         dump_masks=args.dump_masks,

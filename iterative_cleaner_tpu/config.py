@@ -66,6 +66,8 @@ class CleanConfig:
     x64: bool = False              # jax: use float64 intermediates for bit parity
     sharded_batch: bool = False    # clean same-shape archives together on the mesh
     auto_shard: bool = True        # shard one cube over devices when it exceeds HBM
+    chunk_block: int = 0           # force the single-device streaming backend
+                                   # with this subint block size (0 = automatic)
     stream: bool = False           # sharded_batch: dispatch buckets as loads complete
     resume: bool = False           # skip archives whose cleaned output exists
     dump_masks: bool = False       # save mask history NPZ next to the output
@@ -102,6 +104,15 @@ class CleanConfig:
                              "sharded_batch=True yet; drop one of them")
         if self.sharded_batch and self.backend != "jax":
             raise ValueError("sharded_batch=True requires backend='jax'")
+        if self.chunk_block < 0:
+            raise ValueError(f"chunk_block must be >= 0, got {self.chunk_block}")
+        if self.chunk_block and self.backend != "jax":
+            raise ValueError("chunk_block requires backend='jax'")
+        if self.chunk_block and self.sharded_batch:
+            # The sharded-batch driver never routes through the single-cube
+            # chunked backend; rejecting beats silently ignoring the flag.
+            raise ValueError("chunk_block is not supported with "
+                             "sharded_batch=True; drop one of them")
         if self.stream and not self.sharded_batch:
             raise ValueError("stream=True only applies to sharded_batch=True")
         if len(self.pulse_region) != 3:
@@ -138,6 +149,7 @@ class CleanConfig:
             ("pallas", self.pallas),
             ("x64", self.x64),
             ("sharded_batch", self.sharded_batch),
+            ("chunk_block", self.chunk_block),
         ]
         inner = ", ".join(f"{k}={v!r}" for k, v in fields)
         return f"Namespace({inner})"

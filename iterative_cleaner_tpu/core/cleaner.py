@@ -98,7 +98,14 @@ def clean_cube(
     stepwise path, so progress / history / residual all keep working.
     """
     chunk_block = None
-    if cfg.backend == "jax" and cfg.auto_shard:
+    chunk_why = ""
+    if cfg.backend == "jax" and cfg.chunk_block:
+        # Explicit operator override: stream with this block size no matter
+        # what the working-set estimate says (the escape hatch for hosts
+        # where the estimate or the reported device memory is off).
+        chunk_block = int(cfg.chunk_block)
+        chunk_why = "--chunk_block override"
+    elif cfg.backend == "jax" and cfg.auto_shard:
         from iterative_cleaner_tpu.parallel.autoshard import (
             chunk_block_subints,
             maybe_clean_sharded,
@@ -108,22 +115,26 @@ def clean_cube(
         if sharded is not None:
             return sharded
         chunk_block = chunk_block_subints(D.shape, cfg)
-        if chunk_block is not None:
-            import sys
+        chunk_why = f"cube {tuple(D.shape)} exceeds device memory"
+    if chunk_block is not None:
+        # Announce the reroute and its caveats on both routes — an operator
+        # forcing --chunk_block with --fused/--x64 gets the same honesty as
+        # the automatic path.
+        import sys
 
-            notes = []
-            if cfg.fused:
-                notes.append("fused loop runs stepwise on this path")
-            if cfg.x64:
-                notes.append("x64: block-wise template accumulation "
-                             "reorders the f64 sum, so bit-identity of "
-                             "intermediate values vs the in-memory path "
-                             "is not guaranteed")
-            print(
-                f"chunked clean: cube {tuple(D.shape)} exceeds device "
-                f"memory; streaming {chunk_block}-subint blocks through "
-                f"the device{' (' + '; '.join(notes) + ')' if notes else ''}",
-                file=sys.stderr)
+        notes = []
+        if cfg.fused:
+            notes.append("fused loop runs stepwise on this path")
+        if cfg.x64:
+            notes.append("x64: block-wise template accumulation "
+                         "reorders the f64 sum, so bit-identity of "
+                         "intermediate values vs the in-memory path "
+                         "is not guaranteed")
+        print(
+            f"chunked clean: {chunk_why}; streaming {chunk_block}-subint "
+            f"blocks through the device"
+            f"{' (' + '; '.join(notes) + ')' if notes else ''}",
+            file=sys.stderr)
 
     if cfg.fused and chunk_block is None:
         from iterative_cleaner_tpu.backends.jax_backend import run_fused

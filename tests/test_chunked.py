@@ -104,6 +104,41 @@ def test_chunk_block_subints_sizing(monkeypatch):
     assert autoshard.chunk_block_subints((8, 16, 64), cfg) == 1
 
 
+class TestChunkBlockOverride:
+    """--chunk_block N forces the streaming backend regardless of the
+    device-memory estimate."""
+
+    def test_explicit_block_forces_chunked(self, monkeypatch):
+        monkeypatch.delenv("ICT_HBM_BYTES", raising=False)
+        D, w0 = _cube(seed=90)
+        res = clean_cube(D, w0, CleanConfig(
+            backend="jax", max_iter=3, chunk_block=3))
+        res_np = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=3))
+        np.testing.assert_array_equal(res.weights, res_np.weights)
+        assert res.history  # stepwise path ran
+
+    def test_cli_flag(self, tmp_path, monkeypatch):
+        from iterative_cleaner_tpu.cli import main
+        from iterative_cleaner_tpu.io.npz import NpzIO
+
+        monkeypatch.chdir(tmp_path)
+        p = str(tmp_path / "c.npz")
+        NpzIO().save(make_archive(nsub=8, nchan=16, nbin=64, seed=91), p)
+        rc = main(["--backend", "jax", "--chunk_block", "2", "-q", "-l", p])
+        assert rc == 0
+        import os
+
+        assert os.path.exists(p + "_cleaned.npz")
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="chunk_block"):
+            CleanConfig(backend="numpy", chunk_block=2)
+        with pytest.raises(ValueError, match="chunk_block"):
+            CleanConfig(backend="jax", chunk_block=-1)
+        with pytest.raises(ValueError, match="chunk_block"):
+            CleanConfig(backend="jax", chunk_block=2, sharded_batch=True)
+
+
 class TestChunkedRouting:
     """clean_cube must fall through to the chunked backend whenever the cube
     is oversized but the sharded reroute declines."""
