@@ -13,7 +13,12 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from iterative_cleaner_tpu.io.base import Archive, STATE_INTENSITY
+from iterative_cleaner_tpu.io.base import (
+    Archive,
+    STATE_COHERENCE,
+    STATE_INTENSITY,
+    STATE_STOKES,
+)
 
 
 @dataclass(frozen=True)
@@ -51,13 +56,21 @@ def make_archive(
     bandwidth: float = 78.125,
     dispersed: bool = True,
     noise_sigma: float = 1.0,
+    state: str | None = None,
 ) -> Archive:
     """Build a seeded synthetic archive.
 
     The pulse is injected per channel at its dispersed phase (when
     ``dispersed``), so the dedispersion op has something real to undo; channel
     gains vary smoothly to exercise the per-channel scalers.
+
+    ``state`` defaults by npol the way real archives come: 1 → Intensity,
+    2 → Coherence (pscrunch sums AA+BB), 4 → Stokes (total intensity is
+    pol 0) — so multi-pol end-to-end tests exercise the real pscrunch
+    arithmetic, not the Intensity passthrough.
     """
+    if state is None:
+        state = {1: STATE_INTENSITY, 2: STATE_COHERENCE}.get(npol, STATE_STOKES)
     rng = np.random.default_rng(seed)
     freqs = centre_frequency + bandwidth * (np.arange(nchan) / nchan - 0.5)
 
@@ -109,7 +122,7 @@ def make_archive(
         source="J0000+0000",
         mjd_start=60500.0,
         mjd_end=60500.0 + nsub * 10.0 / 86400.0,
-        state=STATE_INTENSITY,
+        state=state,
         dedispersed=not dispersed,
         filename=f"synthetic_seed{seed}",
     )

@@ -23,20 +23,21 @@ from iterative_cleaner_tpu.core.cleaner import clean_cube
 from iterative_cleaner_tpu.io.synthetic import make_archive
 from iterative_cleaner_tpu.ops.preprocess import preprocess
 
-FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
-                       "psrchive_golden.npz")
+FIXTURES = ["psrchive_golden.npz", "psrchive_golden_pol2.npz"]
 
 
-@pytest.fixture(scope="module")
-def golden():
-    with np.load(FIXTURE) as z:
+@pytest.fixture(scope="module", params=FIXTURES)
+def golden(request):
+    path = os.path.join(os.path.dirname(__file__), "fixtures", request.param)
+    with np.load(path) as z:
         return {k: z[k] for k in z.files}
 
 
 @pytest.fixture(scope="module")
 def archive(golden):
     return make_archive(nsub=int(golden["nsub"]), nchan=int(golden["nchan"]),
-                        nbin=int(golden["nbin"]), seed=int(golden["seed"]))
+                        nbin=int(golden["nbin"]), seed=int(golden["seed"]),
+                        npol=int(golden["npol"]))
 
 
 def test_preprocess_matches_golden_bitwise(golden, archive):

@@ -44,11 +44,16 @@ from iterative_cleaner_tpu.ops.preprocess import (
     pscrunch,
 )
 
-NSUB, NCHAN, NBIN, SEED = 8, 64, 256, 42
 MAX_ITER = 5
-OUT = os.path.join(
+_FIXDIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "tests", "fixtures", "psrchive_golden.npz")
+    "tests", "fixtures")
+# (filename, nsub, nchan, nbin, seed, npol): the Intensity config plus a
+# 2-pol Coherence one so the emulation also pins pscrunch = AA+BB.
+CONFIGS = [
+    ("psrchive_golden.npz", 8, 64, 256, 42, 1),
+    ("psrchive_golden_pol2.npz", 6, 32, 128, 77, 2),
+]
 
 
 def per_profile_min_window_baseline(cube: np.ndarray, frac: float = BASELINE_FRAC) -> np.ndarray:
@@ -99,27 +104,34 @@ def zap_iou(wa: np.ndarray, wb: np.ndarray) -> float:
 
 
 def main() -> None:
-    ar = make_archive(nsub=NSUB, nchan=NCHAN, nbin=NBIN, seed=SEED)
-    D_ours, w0 = preprocess(ar, prefer_native=False)
-    D_psr = emulate_psrchive_preprocess(ar)
+    os.makedirs(_FIXDIR, exist_ok=True)
+    for name, nsub, nchan, nbin, seed, npol in CONFIGS:
+        ar = make_archive(nsub=nsub, nchan=nchan, nbin=nbin, seed=seed,
+                          npol=npol)
+        D_ours, w0 = preprocess(ar, prefer_native=False)
+        D_psr = emulate_psrchive_preprocess(ar)
 
-    cfg = CleanConfig(backend="numpy", max_iter=MAX_ITER)
-    res_ours = clean_cube(D_ours, w0, cfg)
-    res_psr = clean_cube(D_psr, w0, cfg)
-    iou = zap_iou(res_ours.weights, res_psr.weights)
-    print(f"ours: loops={res_ours.loops} zapped={(res_ours.weights == 0).sum()}")
-    print(f"psr : loops={res_psr.loops} zapped={(res_psr.weights == 0).sum()}")
-    print(f"mask IoU (documented preprocess divergences): {iou}")
+        cfg = CleanConfig(backend="numpy", max_iter=MAX_ITER)
+        res_ours = clean_cube(D_ours, w0, cfg)
+        res_psr = clean_cube(D_psr, w0, cfg)
+        iou = zap_iou(res_ours.weights, res_psr.weights)
+        print(f"[{name}] state={ar.state}")
+        print(f"  ours: loops={res_ours.loops} "
+              f"zapped={(res_ours.weights == 0).sum()}")
+        print(f"  psr : loops={res_psr.loops} "
+              f"zapped={(res_psr.weights == 0).sum()}")
+        print(f"  mask IoU (documented preprocess divergences): {iou}")
 
-    os.makedirs(os.path.dirname(OUT), exist_ok=True)
-    np.savez_compressed(
-        OUT,
-        nsub=NSUB, nchan=NCHAN, nbin=NBIN, seed=SEED, max_iter=MAX_ITER,
-        D_ours=D_ours, D_psrchive_emulated=D_psr, w0=w0,
-        mask_ours=res_ours.weights, mask_psrchive=res_psr.weights,
-        iou=iou,
-    )
-    print(f"wrote {OUT} ({os.path.getsize(OUT) / 1e6:.2f} MB)")
+        out = os.path.join(_FIXDIR, name)
+        np.savez_compressed(
+            out,
+            nsub=nsub, nchan=nchan, nbin=nbin, seed=seed, npol=npol,
+            max_iter=MAX_ITER,
+            D_ours=D_ours, D_psrchive_emulated=D_psr, w0=w0,
+            mask_ours=res_ours.weights, mask_psrchive=res_psr.weights,
+            iou=iou,
+        )
+        print(f"  wrote {out} ({os.path.getsize(out) / 1e6:.2f} MB)")
 
 
 if __name__ == "__main__":
