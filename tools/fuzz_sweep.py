@@ -1,0 +1,92 @@
+"""Deep backend-equivalence fuzz sweep (offline; CI runs a 12-seed subset).
+
+Draws random configurations with tests/test_fuzz_equivalence.py's generator
+and demands bit-identical final masks between the numpy oracle and every JAX
+execution mode — stepwise, fused, chunked (random block), and the 8-device
+sharded path — plus loop-count agreement.  Any failing seed is reproducible
+directly in the CI test by adding it to the parametrize range.
+
+Usage: JAX_PLATFORMS=cpu python tools/fuzz_sweep.py [n_seeds] [start]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+# Force, don't setdefault: the dev environment exports JAX_PLATFORMS=axon
+# and a wedged tunnel hangs any axon init (same guard as tests/conftest.py).
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+    start = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from test_fuzz_equivalence import draw_case  # noqa: E402
+
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.core.cleaner import clean_cube
+    from iterative_cleaner_tpu.ops.preprocess import preprocess
+    from iterative_cleaner_tpu.parallel.mesh import make_mesh
+    from iterative_cleaner_tpu.parallel.sharded import sharded_clean_single
+
+    mesh = make_mesh(8, devices=jax.devices("cpu"))
+    failures = []
+    for k in range(n):
+        seed = start + k
+        archive, kw = draw_case(seed)
+        D, w0 = preprocess(archive)
+        res_np = clean_cube(D, w0, CleanConfig(backend="numpy", **kw))
+
+        rng = np.random.default_rng(seed)
+        block = int(rng.integers(1, D.shape[0] + 1))
+        modes = {}
+        for name, cfg in (
+            ("stepwise", CleanConfig(backend="jax", **kw)),
+            ("fused", CleanConfig(backend="jax", fused=True, **kw)),
+            # chunk_block routes through the canonical stepwise loop with
+            # the streaming backend — no hand-rolled convergence here.
+            (f"chunked(b={block})",
+             CleanConfig(backend="jax", chunk_block=block, **kw)),
+        ):
+            r = clean_cube(D, w0, cfg)
+            modes[name] = (r.weights, r.loops, r.converged)
+
+        _t, w_sh, loops_sh, done_sh = sharded_clean_single(
+            D, w0, CleanConfig(backend="jax", **kw), mesh)
+        modes["sharded"] = (w_sh, loops_sh, done_sh)
+
+        bad = [name for name, (w, loops, conv) in modes.items()
+               if not (np.array_equal(w, res_np.weights)
+                       and loops == res_np.loops
+                       and conv == res_np.converged)]
+        status = "FAIL " + ",".join(bad) if bad else "ok"
+        if bad:
+            failures.append((seed, bad))
+        print(f"seed {seed}: cube {D.shape} max_iter={kw['max_iter']} "
+              f"loops={res_np.loops} zap={(res_np.weights == 0).sum()} "
+              f"{status}", flush=True)
+
+    print(f"\n{n - len(failures)}/{n} seeds bit-identical across all modes")
+    for seed, bad in failures:
+        print(f"  FAIL seed={seed}: {bad}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
