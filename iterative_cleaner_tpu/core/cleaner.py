@@ -99,6 +99,10 @@ def clean_cube(
     """
     chunk_block = None
     chunk_why = ""
+    if cfg.backend == "jax":
+        from iterative_cleaner_tpu.utils.compile_cache import note_compiled_shape
+
+        note_compiled_shape(tuple(D.shape))
     if cfg.backend == "jax" and cfg.chunk_block:
         # Explicit operator override: stream with this block size no matter
         # what the working-set estimate says (the escape hatch for hosts

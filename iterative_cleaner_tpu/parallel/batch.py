@@ -57,6 +57,9 @@ def _finish_bucket(items, idxs, Db, w0b, cfg, mesh, on_item=None) -> None:
     ``on_item(i, item)`` fires per finished archive — the streaming driver
     emits outputs there and releases the item's host arrays, which is what
     makes its memory bound real."""
+    from iterative_cleaner_tpu.utils.compile_cache import note_compiled_shape
+
+    note_compiled_shape(tuple(Db.shape))
     test_b, w_b, loops_b, done_b = sharded_clean(Db, w0b, cfg, mesh)
     for j, i in enumerate(idxs):
         item = items[i]

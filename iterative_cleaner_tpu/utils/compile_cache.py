@@ -1,0 +1,35 @@
+"""Bounded XLA-executable growth for heterogeneous-shape workloads.
+
+Every distinct cube shape (and sharded-batch size) compiles a fresh set of
+XLA executables that JAX caches for the life of the process.  Deep fuzzing
+found the accumulation is not harmless: ~70 distinct mixed-shape compiles
+into one process segfaulted the virtual-CPU platform deterministically
+(tools/fuzz_sweep.py works around it with a periodic ``jax.clear_caches()``).
+Real deployments bucket archives by shape (parallel/batch.py) so one process
+rarely sees more than a few shapes — but a heterogeneous-directory workload
+can approach that regime, so the drivers note each shape they are about to
+compile here and the caches are dropped every ``DISTINCT_SHAPE_LIMIT``
+distinct shapes.  A drop only costs a recompile of whatever runs next; live
+device arrays are untouched.
+"""
+
+from __future__ import annotations
+
+DISTINCT_SHAPE_LIMIT = 20  # matches the fuzz sweep's empirically safe cadence
+
+_seen: set[tuple] = set()
+
+
+def note_compiled_shape(key: tuple) -> bool:
+    """Record a shape key about to be jit-compiled; drop JAX's compilation
+    caches once ``DISTINCT_SHAPE_LIMIT`` distinct keys accumulate.  Returns
+    True when a drop happened (the counter restarts).  Only call on the JAX
+    path — the numpy backend must stay JAX-import-free."""
+    _seen.add(tuple(key))
+    if len(_seen) >= DISTINCT_SHAPE_LIMIT:
+        import jax
+
+        jax.clear_caches()
+        _seen.clear()
+        return True
+    return False

@@ -1,0 +1,73 @@
+"""The heterogeneous-shape compile-cache guard (utils/compile_cache.py).
+
+Deep fuzzing showed ~70 distinct cube shapes compiled into one process
+segfault the virtual-CPU platform; the drivers bound that growth by noting
+each shape they compile and dropping JAX's caches periodically.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube
+from iterative_cleaner_tpu.utils import compile_cache
+
+
+@pytest.fixture(autouse=True)
+def _fresh_guard():
+    compile_cache._seen.clear()
+    yield
+    compile_cache._seen.clear()
+
+
+def test_drop_fires_at_limit_for_distinct_shapes(monkeypatch):
+    calls = []
+    monkeypatch.setattr("jax.clear_caches", lambda: calls.append(1))
+    n = compile_cache.DISTINCT_SHAPE_LIMIT
+    for k in range(n - 1):
+        assert not compile_cache.note_compiled_shape((8, 64, 256 + k))
+    assert compile_cache.note_compiled_shape((8, 64, 9999))  # the n-th shape
+    assert len(calls) == 1
+    # Counter restarted: the next distinct shape starts a fresh window.
+    assert not compile_cache.note_compiled_shape((8, 64, 256))
+
+
+def test_repeated_shapes_never_drop(monkeypatch):
+    calls = []
+    monkeypatch.setattr("jax.clear_caches", lambda: calls.append(1))
+    for _ in range(5 * compile_cache.DISTINCT_SHAPE_LIMIT):
+        compile_cache.note_compiled_shape((8, 64, 256))
+    assert not calls
+
+
+def test_clean_cube_notes_shape_on_jax_path_only(small_archive, monkeypatch):
+    from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+    seen = []
+    # clean_cube imports the symbol at call time, so patching the module
+    # attribute intercepts it.
+    monkeypatch.setattr(
+        compile_cache, "note_compiled_shape",
+        lambda key: bool(seen.append(key)))
+    D, w0 = preprocess(small_archive)
+    clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=1))
+    assert seen == []  # numpy path stays JAX-free
+    clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1))
+    assert seen == [tuple(D.shape)]
+
+
+def test_masks_survive_a_cache_drop(small_archive):
+    """A drop mid-workload must not change results — only cost a recompile."""
+    import jax
+
+    from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+    D, w0 = preprocess(small_archive)
+    cfg = CleanConfig(backend="jax", max_iter=3)
+    ref = clean_cube(D, w0, cfg)
+    jax.clear_caches()
+    again = clean_cube(D, w0, cfg)
+    assert np.array_equal(ref.weights, again.weights)
+    assert ref.loops == again.loops
