@@ -21,6 +21,7 @@ import numpy as np
 
 from iterative_cleaner_tpu.config import CleanConfig
 from iterative_cleaner_tpu.backends.base import make_backend
+from iterative_cleaner_tpu.utils.compile_cache import note_compiled_shape
 
 
 @dataclass
@@ -99,10 +100,6 @@ def clean_cube(
     """
     chunk_block = None
     chunk_why = ""
-    if cfg.backend == "jax":
-        from iterative_cleaner_tpu.utils.compile_cache import note_compiled_shape
-
-        note_compiled_shape(tuple(D.shape))
     if cfg.backend == "jax" and cfg.chunk_block:
         # Explicit operator override: stream with this block size no matter
         # what the working-set estimate says (the escape hatch for hosts
@@ -117,6 +114,7 @@ def clean_cube(
 
         sharded = maybe_clean_sharded(D, w0, cfg, want_residual)
         if sharded is not None:
+            note_compiled_shape(tuple(D.shape))
             return sharded
         chunk_block = chunk_block_subints(D.shape, cfg)
         chunk_why = f"cube {tuple(D.shape)} exceeds device memory"
@@ -139,6 +137,18 @@ def clean_cube(
             f"blocks through the device"
             f"{' (' + '; '.join(notes) + ')' if notes else ''}",
             file=sys.stderr)
+
+    if cfg.backend == "jax":
+        nsub, nchan, nbin = D.shape
+        if chunk_block is not None:
+            # Chunked executables are keyed by the block slab shape, not the
+            # cube: distinct-nsub cubes sharing one block size reuse one
+            # executable set and must not count as distinct shapes.
+            note_compiled_shape((min(chunk_block, nsub), nchan, nbin))
+            if nsub > chunk_block and nsub % chunk_block:
+                note_compiled_shape((nsub % chunk_block, nchan, nbin))
+        else:
+            note_compiled_shape((nsub, nchan, nbin))
 
     if cfg.fused and chunk_block is None:
         from iterative_cleaner_tpu.backends.jax_backend import run_fused

@@ -24,6 +24,7 @@ from iterative_cleaner_tpu.io.base import Archive, get_io
 from iterative_cleaner_tpu.ops.preprocess import preprocess
 from iterative_cleaner_tpu.parallel.mesh import make_mesh
 from iterative_cleaner_tpu.parallel.sharded import sharded_clean
+from iterative_cleaner_tpu.utils.compile_cache import note_compiled_shape
 
 
 @dataclass
@@ -57,8 +58,6 @@ def _finish_bucket(items, idxs, Db, w0b, cfg, mesh, on_item=None) -> None:
     ``on_item(i, item)`` fires per finished archive — the streaming driver
     emits outputs there and releases the item's host arrays, which is what
     makes its memory bound real."""
-    from iterative_cleaner_tpu.utils.compile_cache import note_compiled_shape
-
     note_compiled_shape(tuple(Db.shape))
     test_b, w_b, loops_b, done_b = sharded_clean(Db, w0b, cfg, mesh)
     for j, i in enumerate(idxs):

@@ -46,16 +46,35 @@ def test_clean_cube_notes_shape_on_jax_path_only(small_archive, monkeypatch):
     from iterative_cleaner_tpu.ops.preprocess import preprocess
 
     seen = []
-    # clean_cube imports the symbol at call time, so patching the module
-    # attribute intercepts it.
+    # cleaner.py binds the symbol at import, so patch its namespace.
     monkeypatch.setattr(
-        compile_cache, "note_compiled_shape",
+        "iterative_cleaner_tpu.core.cleaner.note_compiled_shape",
         lambda key: bool(seen.append(key)))
     D, w0 = preprocess(small_archive)
     clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=1))
     assert seen == []  # numpy path stays JAX-free
     clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1))
     assert seen == [tuple(D.shape)]
+
+
+def test_chunked_route_notes_block_shape(small_archive, monkeypatch):
+    """Chunked executables are keyed by the block slab, not the cube — a
+    directory of distinct-nsub >HBM cubes sharing one block size must not
+    count as distinct shapes (it reuses one executable set)."""
+    from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+    seen = []
+    monkeypatch.setattr(
+        "iterative_cleaner_tpu.core.cleaner.note_compiled_shape",
+        lambda key: bool(seen.append(key)))
+    D, w0 = preprocess(small_archive)
+    nsub, nchan, nbin = D.shape
+    block = max(nsub // 2 - 1, 1)  # forces a remainder slab
+    clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1, chunk_block=block))
+    expect = [(block, nchan, nbin)]
+    if nsub > block and nsub % block:
+        expect.append((nsub % block, nchan, nbin))
+    assert seen == expect
 
 
 def test_masks_survive_a_cache_drop(small_archive):

@@ -1,0 +1,130 @@
+"""On-chip probe: alternative lowerings of the two dominant step phases.
+
+The r03 phase telemetry (BENCH_r03) showed the per-iteration cost is NOT
+where the design assumed: template build (one cube read, 0.068 s) and the
+robust scalers (nsub x nchan maps, 0.064 s) dominate, while fit + moments +
+FFT together cost < 0.015 s.  This probe times candidate lowerings of both
+phases on the real chip to pick replacements; mask parity of any winner is
+then validated by the fuzz sweep before adoption.
+
+Usage: python tools/probe_template_perf.py  (don't set JAX_PLATFORMS; the
+default backend is the real TPU behind the axon tunnel).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+NSUB, NCHAN, NBIN = 256, 1024, 1024
+
+
+def _force(x):
+    import jax.numpy as jnp
+
+    np.asarray(jnp.sum(x))
+
+
+def _t(fn, n=5):
+    fn()  # compile
+    times = []
+    for _ in range(n):
+        t0 = time.time()
+        fn()
+        times.append(time.time() - t0)
+    return min(times)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+
+    rng = np.random.default_rng(0)
+    D = jnp.asarray(rng.standard_normal((NSUB, NCHAN, NBIN)), jnp.float32)
+    w = jnp.asarray(rng.random((NSUB, NCHAN)), jnp.float32)
+    _force(D)
+    cube_gb = NSUB * NCHAN * NBIN * 4 / 1e9
+
+    HI = lax.Precision.HIGHEST
+
+    # --- template build variants (einsum "sc,scb->b") ---
+    variants = {
+        "einsum_highest": jax.jit(
+            lambda D, w: jnp.einsum("sc,scb->b", w, D, precision=HI)),
+        "einsum_default": jax.jit(
+            lambda D, w: jnp.einsum("sc,scb->b", w, D)),
+        "mul_reduce": jax.jit(
+            lambda D, w: jnp.sum(w[..., None] * D, axis=(0, 1))),
+        "matvec_2d_highest": jax.jit(
+            lambda D, w: jnp.matmul(
+                w.reshape(-1), D.reshape(-1, NBIN), precision=HI)),
+        "matvec_2d_default": jax.jit(
+            lambda D, w: jnp.matmul(w.reshape(-1), D.reshape(-1, NBIN))),
+        "two_stage_highest": jax.jit(
+            lambda D, w: jnp.einsum(
+                "c,cb->b",
+                jnp.ones(NCHAN, jnp.float32),
+                jnp.einsum("sc,scb->cb", w, D, precision=HI),
+                precision=HI)),
+    }
+    print("--- template build (one cube read; roofline "
+          f"{cube_gb:.2f} GB) ---", file=sys.stderr)
+    results = {}
+    for name, fn in variants.items():
+        try:
+            t = _t(lambda fn=fn: _force(fn(D, w)))
+            results[name] = t
+            print(f"{name:24s} {t * 1e3:8.2f} ms  "
+                  f"({cube_gb / t:6.1f} GB/s)", file=sys.stderr)
+        except Exception as exc:  # noqa: BLE001 — probe-only
+            print(f"{name:24s} FAILED: {exc}", file=sys.stderr)
+
+    # Numerics: max |delta| vs the current lowering, in ulps of the result
+    # scale — tells us how much mask-flip risk a switch carries.
+    ref = np.asarray(variants["einsum_highest"](D, w))
+    for name, fn in variants.items():
+        out = np.asarray(fn(D, w))
+        d = np.abs(out - ref).max()
+        rel = d / max(np.abs(ref).max(), 1e-30)
+        print(f"numerics {name:24s} max|d|={d:.3e} rel={rel:.3e}",
+              file=sys.stderr)
+
+    # --- scalers variants ---
+    from iterative_cleaner_tpu.ops.stats import scale_and_combine
+    from iterative_cleaner_tpu.ops.masked import masked_median
+
+    d4 = [jnp.asarray(rng.standard_normal((NSUB, NCHAN)), jnp.float32)
+          for _ in range(4)]
+    valid = jnp.asarray(rng.random((NSUB, NCHAN)) > 0.05)
+
+    cur = jax.jit(lambda a, b, c, d, v: scale_and_combine(
+        a, b, c, d, v, 5.0, 5.0))
+    t = _t(lambda: _force(cur(*d4, valid)))
+    print(f"--- scalers ---\ncurrent scale_and_combine  {t * 1e3:8.2f} ms",
+          file=sys.stderr)
+
+    # Batched masked median: one sort of (3, nsub, nchan) instead of three.
+    # Axis map: 2-D axis=1 (over channels) == stacked axis=2; 2-D axis=0
+    # (over subints) == stacked axis=1.
+    stacked = jnp.stack(d4[:3])
+    vv = jnp.broadcast_to(valid, stacked.shape)
+    for ax2d, ax3d in ((1, 2), (0, 1)):
+        one = jax.jit(lambda x, v, a=ax2d: masked_median(x, v, axis=a))
+        three = jax.jit(lambda x, v, a=ax3d: masked_median(x, v, axis=a))
+        t_one = _t(lambda: _force(one(d4[0], valid)))
+        t_three = _t(lambda: _force(three(stacked, vv)))
+        print(f"masked_median axis={ax2d}: 1x {t_one * 1e3:7.2f} ms   "
+              f"3x-stacked {t_three * 1e3:7.2f} ms "
+              f"(batched saves {(3 * t_one - t_three) * 1e3:6.2f} ms)",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
