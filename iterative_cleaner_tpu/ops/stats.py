@@ -82,7 +82,9 @@ def scale_masked(diag: jnp.ndarray, valid: jnp.ndarray, axis: int, thresh: float
     """Type-A robust scaling along ``axis`` with numpy.ma leak semantics.
 
     Returns the final |scaled|/thresh *data* (plain array — the caller is
-    downstream of the mask-drop).
+    downstream of the mask-drop).  Reference implementation of the rule
+    table above; the production path is the batched :func:`_scale_axis`,
+    which must stay bit-identical to this.
     """
     med, n = masked_median(diag, valid, axis=axis)
     has = n > 0
@@ -100,11 +102,69 @@ def scale_masked(diag: jnp.ndarray, valid: jnp.ndarray, axis: int, thresh: float
 
 
 def scale_plain(diag: jnp.ndarray, axis: int, thresh: float):
-    """Type-B scaling: plain IEEE arithmetic, no mask anywhere (§8.L1)."""
+    """Type-B scaling: plain IEEE arithmetic, no mask anywhere (§8.L1).
+    Reference implementation; the production path is :func:`_scale_axis`."""
     med = nan_propagating_median(diag, axis=axis)
     r = diag - jnp.expand_dims(med, axis)
     mad = nan_propagating_median(jnp.abs(r), axis=axis)
     return jnp.abs(r / jnp.expand_dims(mad, axis)) / thresh
+
+
+def _select_medians(filled: jnp.ndarray, n: jnp.ndarray, ax3: int):
+    """Per-row medians of a (4, nsub, nchan) stack along ``ax3``, ONE sort.
+
+    Rows 0-2 carry +inf at invalid positions and use count-based selection
+    with even-count averaging (np.ma.median semantics; NaN when ``n`` — the
+    per-line valid count — is 0).  Row 3 carries raw values and uses plain
+    np.median semantics: static middle pair, NaN if any NaN is present in
+    the row along the axis.
+    """
+    size = filled.shape[ax3]
+    x = jnp.moveaxis(filled, ax3, -1)            # (4, A, size)
+    srt = jnp.sort(x, axis=-1)
+    lo = jnp.clip((n - 1) // 2, 0, size - 1)     # (A,)
+    hi = jnp.clip(n // 2, 0, size - 1)
+    idx = jnp.stack((lo, hi), axis=-1)[None]     # (1, A, 2)
+    pair = jnp.take_along_axis(srt[:3], jnp.broadcast_to(idx, (3,) + idx.shape[1:]),
+                               axis=-1)
+    med_masked = jnp.where(n > 0, jnp.sum(pair, axis=-1) * 0.5, jnp.nan)
+    mid = (srt[3, ..., (size - 1) // 2] + srt[3, ..., size // 2]) * 0.5
+    med_plain = jnp.where(jnp.isnan(x[3]).any(axis=-1), jnp.nan, mid)
+    return jnp.concatenate((med_masked, med_plain[None]), axis=0)  # (4, A)
+
+
+def _scale_axis(stack4: jnp.ndarray, valid: jnp.ndarray,
+                axis: int, thresh: float) -> jnp.ndarray:
+    """All four diagnostics robust-scaled along 2-D ``axis`` — the batched
+    production form of :func:`scale_masked` (rows 0-2) + :func:`scale_plain`
+    (row 3), two sorts of a (4, nsub, nchan) stack instead of eight separate
+    ones.  Per-row sorting and selection are independent, so each row is
+    bit-identical to its reference implementation.
+    """
+    ax3 = axis + 1
+    n = jnp.sum(valid, axis=axis)
+    valid3 = valid[None]
+    filled = jnp.concatenate(
+        (jnp.where(valid3, stack4[:3], jnp.inf), stack4[3:]), axis=0)
+    med = _select_medians(filled, n, ax3)
+    r = stack4 - jnp.expand_dims(med, ax3)
+    abs_r = jnp.abs(r)
+    filled_r = jnp.concatenate(
+        (jnp.where(valid3, abs_r[:3], jnp.inf), abs_r[3:]), axis=0)
+    mad = _select_medians(filled_r, n, ax3)
+
+    has = n > 0                                   # (A,)
+    madA, madB = mad[:3], mad[3]
+    mad_ok = has[None] & (madA != 0) & ~jnp.isnan(madA)
+    mad_ok_b = jnp.expand_dims(mad_ok, ax3)
+    madA_b = jnp.expand_dims(jnp.where(mad_ok, madA, 1.0), ax3)
+    # Two-division op order matches the reference: (r/MAD), abs, /thresh.
+    scaled_ok = jnp.abs(r[:3] / madA_b) / thresh
+    scaled_valid = jnp.where(mad_ok_b, scaled_ok, abs_r[:3])
+    has_b = jnp.expand_dims(jnp.expand_dims(has, 0), ax3)
+    type_a = jnp.where(valid3 & has_b, scaled_valid, jnp.abs(stack4[:3]))
+    type_b = jnp.abs(r[3] / jnp.expand_dims(madB, ax3 - 1)) / thresh
+    return jnp.concatenate((type_a, type_b[None]), axis=0)
 
 
 def comprehensive_stats(
@@ -135,22 +195,16 @@ def scale_and_combine(
 ) -> jnp.ndarray:
     """Robust-scale the four diagnostics and combine (reference :220-224).
 
-    The three type-A diagnostics are stacked so each axis needs ONE sort of a
-    (3, nsub, nchan) array instead of three separate sorts — r03 phase
-    telemetry put the scalers at ~44% of the device step, dominated by sort
-    launches.  Rows sort independently, so the batched medians are
-    bit-identical to the per-diagnostic ones.
+    All four diagnostics are stacked so each axis needs TWO sorts of one
+    (4, nsub, nchan) array (values, then absolute deviations) instead of
+    eight separate ones — r03 phase telemetry put the scalers at ~44% of
+    the device step, dominated by sort launches.  Rows sort and select
+    independently (type-A count-based selection for the masked rows, plain
+    np.median semantics for the mask-blind FFT row), so every row is
+    bit-identical to its unbatched reference implementation above.
     """
-    stacked = jnp.stack((d_std, d_mean, d_ptp), axis=0)
-    valid3 = jnp.broadcast_to(valid, stacked.shape)
-    # 2-D axis=0 (across subints, /chanthresh) == stacked axis=1; 2-D axis=1
-    # (across channels, /subintthresh) == stacked axis=2.
-    per_chan = scale_masked(stacked, valid3, axis=1, thresh=chanthresh)
-    per_subint = scale_masked(stacked, valid3, axis=2, thresh=subintthresh)
+    stack4 = jnp.stack((d_std, d_mean, d_ptp, d_fft), axis=0)
+    per_chan = _scale_axis(stack4, valid, axis=0, thresh=chanthresh)
+    per_subint = _scale_axis(stack4, valid, axis=1, thresh=subintthresh)
     combined = jnp.maximum(per_chan, per_subint)  # mask-drop (§8.L2)
-    fft_combined = jnp.maximum(
-        scale_plain(d_fft, axis=0, thresh=chanthresh),
-        scale_plain(d_fft, axis=1, thresh=subintthresh),
-    )
-    return nan_propagating_median(
-        jnp.concatenate((combined, fft_combined[None]), axis=0), axis=0)
+    return nan_propagating_median(combined, axis=0)

@@ -58,3 +58,43 @@ def test_nan_propagating_median_inf_ok():
     got = np.asarray(nan_propagating_median(jnp.asarray(x), axis=1))
     assert got[0] == np.inf  # (2 + inf)/2, as np.median gives
     np.testing.assert_allclose(got, np.median(x, axis=1))
+
+
+class TestScaleAxisBatched:
+    """The batched production scaler (_scale_axis) must stay bit-identical
+    to the unbatched reference implementations (scale_masked row-by-row for
+    the three masked diagnostics, scale_plain for the mask-blind FFT row) —
+    including the §8.L2-L4 leak semantics at the edges."""
+
+    def _case(self, seed, nsub, nchan):
+        rng = np.random.default_rng(seed)
+        diags = rng.standard_normal((4, nsub, nchan)).astype(np.float32)
+        valid = rng.random((nsub, nchan)) > 0.2
+        if seed % 3 == 0:
+            valid[2, :] = False          # fully-masked subint
+            valid[:, 5] = False          # fully-masked channel
+        if seed % 3 == 1:
+            diags[0, :, 3] = 7.0         # MAD == 0 channel (constant column)
+            diags[3, 1, :] = np.nan      # NaN into the plain FFT row
+        return jnp.asarray(diags), jnp.asarray(valid)
+
+    @pytest.mark.parametrize("seed", range(6))
+    @pytest.mark.parametrize("axis,thresh", [(0, 5.0), (1, 2.5)])
+    # Odd and even dims: even sizes exercise the middle-pair averaging
+    # ((size-1)//2 != size//2) in both selection modes.
+    @pytest.mark.parametrize("nsub,nchan", [(13, 17), (12, 16)])
+    def test_matches_reference_rows(self, seed, axis, thresh, nsub, nchan):
+        from iterative_cleaner_tpu.ops.stats import (
+            _scale_axis,
+            scale_masked,
+            scale_plain,
+        )
+
+        stack4, valid = self._case(seed, nsub, nchan)
+        got = np.asarray(_scale_axis(stack4, valid, axis=axis, thresh=thresh))
+        for row in range(3):
+            want = np.asarray(
+                scale_masked(stack4[row], valid, axis=axis, thresh=thresh))
+            np.testing.assert_array_equal(got[row], want, err_msg=f"row {row}")
+        want_b = np.asarray(scale_plain(stack4[3], axis=axis, thresh=thresh))
+        np.testing.assert_array_equal(got[3], want_b, err_msg="fft row")
