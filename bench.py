@@ -626,35 +626,42 @@ def run_bench() -> dict:
     if "parity_full_loop" in out_a:
         _PAYLOAD["parity_measured_config_full_loop"] = out_a["parity_full_loop"]
 
-    sections = []
-    if os.environ.get("BENCH_SKIP_PHASES", "0") == "0":
-        sections.append(("phases", lambda: _bench_phases(state, dev.device_kind)))
-    if os.environ.get("BENCH_SKIP_PALLAS", "0") == "0":
-        sections.append(("pallas", lambda: _bench_pallas(state)))
-    if os.environ.get("BENCH_SKIP_CHUNKED", "0") == "0":
-        sections.append(("chunked", lambda: _bench_chunked(
-            state, out_a.get("upload_gbps", 0.0))))
-    for name, fn in sections:
+    def run_section(name: str, fn) -> None:
         try:
             _PAYLOAD[name] = fn()
         except Exception as exc:  # noqa: BLE001 — isolate optional sections
             log(f"[{name}] FAILED: {exc}")
             _PAYLOAD[name] = {"error": str(exc)}
+
+    if os.environ.get("BENCH_SKIP_PHASES", "0") == "0":
+        run_section("phases", lambda: _bench_phases(state, dev.device_kind))
+    if os.environ.get("BENCH_SKIP_PALLAS", "0") == "0":
+        run_section("pallas", lambda: _bench_pallas(state))
     if "achieved_gbps" in _PAYLOAD.get("phases", {}):
         _PAYLOAD["achieved_gbps"] = _PAYLOAD["phases"]["achieved_gbps"]
 
-    del state
-
     # --- config B: the north-star shape class ---
+    # Runs BEFORE the chunked arm: the r03 interim run lost config B to a
+    # tunnel that wedged during chunked-arm uploads; order sections by the
+    # value of their data so a mid-run wedge costs the least.  Config A's
+    # device buffers are dropped first (B's working set needs the HBM); the
+    # chunked arm below consumes only the host-side parts of the state.
+    D_a, w0_a, _Dd, _w0d, _validd, w_step1_a = state
+    state = (D_a, w0_a, None, None, None, w_step1_a)
+    del _Dd, _w0d, _validd
     if not skip_b:
-        try:
+        def config_b():
             out_b, state_b = _bench_config(
                 "B", B_NSUB, B_NCHAN, B_NBIN, full_numpy=False, dev=dev)
-            _PAYLOAD["config_b_north_star_shape"] = out_b
             del state_b
-        except Exception as exc:  # noqa: BLE001 — isolate optional sections
-            log(f"[B] FAILED: {exc}")
-            _PAYLOAD["config_b_north_star_shape"] = {"error": str(exc)}
+            return out_b
+
+        run_section("config_b_north_star_shape", config_b)
+
+    if os.environ.get("BENCH_SKIP_CHUNKED", "0") == "0":
+        run_section("chunked", lambda: _bench_chunked(
+            state, out_a.get("upload_gbps", 0.0)))
+    del state
 
     _PAYLOAD["tunnel_note"] = (
         "upload runs through a dev tunnel at ~tens of MB/s; a real TPU host "
