@@ -1,0 +1,69 @@
+"""bench.py's output contract: ONE parseable JSON line on stdout on every
+exit path (CLAUDE.md invariant; the driver records it as BENCH_r{N}.json).
+
+The child runs pinned to the CPU platform — these tests pin the payload
+contract, not TPU numbers; JAX_PLATFORMS=cpu also makes bench skip its
+killable tunnel probe, so the tests are deterministic and fast.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DRIVER_KEYS = ("metric", "value", "unit", "vs_baseline")
+
+
+def _run_bench(extra_env: dict, timeout: int = 540):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO,
+        BENCH_WATCHDOG_S="480",
+        **extra_env,
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, (
+        f"expected exactly one stdout line, got {len(lines)}:\n{out.stdout}\n"
+        f"stderr tail: {out.stderr[-2000:]}")
+    return out.returncode, json.loads(lines[0])
+
+
+@pytest.mark.slow
+def test_success_path_emits_driver_contract():
+    rc, payload = _run_bench({
+        "BENCH_NSUB": "8", "BENCH_NCHAN": "32", "BENCH_NBIN": "64",
+        "BENCH_MAX_ITER": "2", "BENCH_SKIP_NORTHSTAR": "1",
+        "BENCH_SKIP_PALLAS": "1", "BENCH_SKIP_PHASES": "1",
+        "BENCH_SKIP_CHUNKED": "1",
+    })
+    assert rc == 0
+    for key in DRIVER_KEYS:
+        assert key in payload, key
+    assert isinstance(payload["value"], (int, float))
+    assert payload["parity_small_config"] is True
+    assert payload["config_a"]["parity_full_loop"] is True
+    assert "error" not in payload
+
+
+@pytest.mark.slow
+def test_exception_path_still_emits_json():
+    # nbin=0 makes archive synthesis/preprocess blow up well inside
+    # run_bench; the top-level handler must still print the one JSON line.
+    rc, payload = _run_bench({
+        "BENCH_NSUB": "8", "BENCH_NCHAN": "32", "BENCH_NBIN": "0",
+        "BENCH_MAX_ITER": "1",
+    })
+    assert rc == 1
+    for key in DRIVER_KEYS:
+        assert key in payload, key
+    assert "error" in payload and payload["error"]

@@ -90,6 +90,7 @@ print("SLICE%d=%s" % (pid, json.dumps(partition_paths(paths))))
 sys.exit(rc)
 """
 
+    @pytest.mark.slow
     def test_two_process_run(self, tmp_path):
         import json
         import os
@@ -156,6 +157,7 @@ assert loops == res.loops and done == res.converged
 print(f"P{pid}-GLOBALMESH-OK loops={loops}")
 """
 
+    @pytest.mark.slow
     def test_global_mesh_spans_processes(self):
         outs = _run_two_process(
             self.SCRIPT,
