@@ -109,62 +109,33 @@ def _start_watchdog():
     return t
 
 
-def _probe_default_backend(timeout_s: float) -> str:
-    """Probe the default JAX backend in a KILLABLE subprocess.
-
-    A wedged dev tunnel makes the first in-process ``jax.devices()`` hang
-    forever with no recourse but the watchdog (observed live in r03: the
-    hang survives even a JAX_PLATFORMS=cpu env override, because the
-    plugin registration already read the stale config).  Probing in a
-    subprocess turns that hang into a timeout we can act on.
-
-    Returns "ok", "error" (fast failure — the in-process bounded retry
-    handles those; r01's transient RPC error must NOT demote to CPU), or
-    "hang" (killed at the timeout).
-    """
-    import subprocess
-
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=timeout_s)
-        return "ok" if out.returncode == 0 else "error"
-    except subprocess.TimeoutExpired:
-        return "hang"
-
-
-def _force_cpu_backend() -> None:
-    """Pin this process's first backend init to CPU (env for children +
-    config update to beat the plugin registration's stale read)."""
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-
-
 def _init_device(retries: int = 3, sleep_s: float = 20.0):
     """Bounded retry around backend init: the dev tunnel's failure mode is a
     transient RPC error on first contact (r01's bench died to exactly this).
-    A tunnel that HANGS instead is detected by a killable subprocess probe,
-    and the bench falls back to CPU — a degraded-but-real artifact (the
-    payload carries ``tpu_unreachable``) instead of a watchdog zero."""
+    A tunnel that HANGS instead is detected by a killable subprocess probe
+    (shared machinery: iterative_cleaner_tpu.utils.device_probe), and the
+    bench falls back to CPU — a degraded-but-real artifact (the payload
+    carries ``tpu_unreachable``) instead of a watchdog zero."""
+    from iterative_cleaner_tpu.utils.device_probe import (
+        pin_cpu_backend,
+        probe_default_backend,
+    )
     import jax
 
     probe_s = float(os.environ.get("BENCH_PROBE_S", 150))
     if os.environ.get("JAX_PLATFORMS", "") != "cpu" and probe_s > 0:
-        status = _probe_default_backend(probe_s)
+        status = probe_default_backend(probe_s)
         if status == "hang":
             # One more chance before the irreversible CPU pin: a slow
             # first init (cold tunnel) can legitimately exceed one window.
             log(f"backend probe hung for {probe_s:.0f}s; probing once more")
-            status = _probe_default_backend(probe_s)
+            status = probe_default_backend(probe_s)
         if status == "hang":
             log(f"default backend hung through 2x{probe_s:.0f}s probes "
                 "(wedged tunnel?); falling back to CPU — numbers below "
                 "measure the CPU backend, not the TPU")
             _PAYLOAD["tpu_unreachable"] = True
-            _force_cpu_backend()
+            pin_cpu_backend()
         # "error" falls through: fast failures are what the bounded
         # in-process retry below exists for.
 

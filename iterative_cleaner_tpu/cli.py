@@ -167,6 +167,15 @@ def main(argv: list[str] | None = None) -> int:
     except ValueError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
+    if cfg.backend == "jax":
+        # A wedged remote-TPU tunnel hangs the first in-process jax call
+        # forever; probe killably and demote to CPU loudly instead
+        # (utils/device_probe.py — no-op when already pinned to CPU).
+        from iterative_cleaner_tpu.utils.device_probe import (
+            ensure_responsive_backend,
+        )
+
+        ensure_responsive_backend()
     if sweep_pairs is not None:
         from iterative_cleaner_tpu.driver import run_sweep
 

@@ -1,0 +1,104 @@
+"""Killable probing of the default JAX backend + CPU pinning — the single
+home of the wedged-tunnel recipe (the CLI, bench.py, and __graft_entry__.py
+all consume it).
+
+A wedged remote-TPU tunnel makes the FIRST in-process ``jax.devices()`` call
+hang process-wide — no exception, no timeout, and a later
+``JAX_PLATFORMS=cpu`` env override does not rescue it because the plugin
+registration already read the stale config (observed live against the dev
+tunnel).  Probing in a subprocess first turns that hang into a timeout the
+caller can act on; pinning (env var AND config update, never deregistering
+backend factories — that would kill Pallas's "tpu" MLIR platform) makes the
+CPU fallback actually stick.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+# Platforms JAX itself provides; anything else in JAX_PLATFORMS is a
+# registered plugin (e.g. a tunneled remote device) — the only kind that
+# can wedge-hang first init.
+_BUILTIN_PLATFORMS = {"", "cpu", "gpu", "cuda", "rocm", "tpu"}
+
+
+def probe_default_backend(timeout_s: float) -> str:
+    """Probe default-backend init in a KILLABLE subprocess.
+
+    Returns "ok", "error" (fast failure — let the real init surface the real
+    message in-process), or "hang" (killed at the timeout)."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=timeout_s)
+        return "ok" if out.returncode == 0 else "error"
+    except subprocess.TimeoutExpired:
+        return "hang"
+
+
+def pin_cpu_backend() -> None:
+    """Pin this process's first backend init to CPU: env (for subprocesses)
+    AND config update (beats the plugin registration's stale read).  Leaves a
+    process whose backend is already initialized untouched — first-init is
+    the only moment that can hang, and retargeting a live process would
+    silently move its subsequent dispatches."""
+    if _backend_already_live():
+        return
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _backend_already_live() -> bool:
+    try:
+        from jax._src import xla_bridge as _xb
+
+        return bool(getattr(_xb, "_backends", None))
+    except Exception:  # noqa: BLE001 — JAX-version drift: assume not live
+        return False
+
+
+def _remote_platform_in_play() -> bool:
+    """Only a registered plugin platform (or the axon pool env) can
+    wedge-hang; plain local cpu/gpu/tpu machines skip the probe cost."""
+    if os.environ.get("JAX_PLATFORMS", "") not in _BUILTIN_PLATFORMS:
+        return True
+    return bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+
+
+def ensure_responsive_backend(timeout_s: float | None = None) -> str:
+    """CLI front door: probe before the first real JAX call, demote to CPU
+    loudly when the tunnel is wedged (masks are bit-identical on CPU; only
+    wall-clock differs).
+
+    Returns "skipped" (no remote platform in play, already pinned to cpu,
+    probing disabled via ICT_NO_DEVICE_PROBE=1 / ICT_DEVICE_PROBE_S<=0, or
+    a backend is already live), "ok" (probe answered), or "demoted" (probe
+    hung through two windows; process pinned to CPU).
+    """
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("ICT_DEVICE_PROBE_S", 120))
+    if (os.environ.get("ICT_NO_DEVICE_PROBE") == "1"
+            or timeout_s <= 0
+            or os.environ.get("JAX_PLATFORMS", "") == "cpu"
+            or not _remote_platform_in_play()
+            or _backend_already_live()):
+        return "skipped"
+    # Two windows: a cold-tunnel first init can legitimately be slow once.
+    for _ in range(2):
+        if probe_default_backend(timeout_s) != "hang":
+            return "ok"
+    pin_cpu_backend()
+    print(
+        f"warning: the default JAX backend hung through two {timeout_s:.0f}s "
+        "probes (wedged device tunnel?); falling back to the CPU backend — "
+        "masks are identical, wall-clock is not (set ICT_NO_DEVICE_PROBE=1 "
+        "to skip probing)",
+        file=sys.stderr)
+    return "demoted"

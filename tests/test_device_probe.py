@@ -1,0 +1,97 @@
+"""The shared killable device probe (utils/device_probe.py): a wedged tunnel
+must demote the CLI to CPU with a warning instead of hanging the process,
+and healthy local machines must never pay the probe cost."""
+
+from __future__ import annotations
+
+import subprocess
+
+import pytest
+
+from iterative_cleaner_tpu.utils import device_probe
+
+
+def test_skipped_when_pinned_to_cpu(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert device_probe.ensure_responsive_backend() == "skipped"
+
+
+def test_skipped_on_local_platforms(monkeypatch):
+    # No plugin platform, no axon pool: a laptop/local-TPU run must not pay
+    # a probe subprocess at CLI startup.
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    assert device_probe.ensure_responsive_backend() == "skipped"
+
+
+def test_skipped_when_disabled(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("ICT_NO_DEVICE_PROBE", "1")
+    assert device_probe.ensure_responsive_backend() == "skipped"
+
+
+def test_skipped_when_timeout_nonpositive(monkeypatch):
+    # Mirrors bench.py's BENCH_PROBE_S<=0 disable semantics: 0 means "skip
+    # the probe", never "demote instantly".
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("ICT_DEVICE_PROBE_S", "0")
+    assert device_probe.ensure_responsive_backend() == "skipped"
+
+
+def test_skipped_when_backend_already_live(monkeypatch):
+    # The test session has initialized the CPU backend long ago; even with a
+    # non-cpu env the probe must refuse to act on a live process.
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.delenv("ICT_NO_DEVICE_PROBE", raising=False)
+    assert device_probe.ensure_responsive_backend() == "skipped"
+
+
+class TestHangPath:
+    """Simulate the wedge by faking subprocess.run; the live-backend guard is
+    bypassed so the demotion logic itself is exercised."""
+
+    @pytest.fixture
+    def _fresh(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.delenv("ICT_NO_DEVICE_PROBE", raising=False)
+        monkeypatch.delenv("ICT_DEVICE_PROBE_S", raising=False)
+        # Bypass the live-backend guard (the session's CPU backend is up).
+        import jax._src.xla_bridge as xb
+
+        monkeypatch.setattr(xb, "_backends", {}, raising=False)
+
+    def test_hang_demotes_to_cpu(self, _fresh, monkeypatch, capsys):
+        calls = []
+
+        def fake_run(*a, **kw):
+            calls.append(1)
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=kw["timeout"])
+
+        monkeypatch.setattr(device_probe.subprocess, "run", fake_run)
+        out = device_probe.ensure_responsive_backend(timeout_s=0.01)
+        assert out == "demoted"
+        assert len(calls) == 2  # two probe windows before giving up
+        import os
+
+        assert os.environ["JAX_PLATFORMS"] == "cpu"
+        assert "wedged" in capsys.readouterr().err
+
+    def test_fast_error_counts_as_responsive(self, _fresh, monkeypatch):
+        def fake_run(*a, **kw):
+            return subprocess.CompletedProcess(a, returncode=1)
+
+        monkeypatch.setattr(device_probe.subprocess, "run", fake_run)
+        assert device_probe.ensure_responsive_backend(timeout_s=0.01) == "ok"
+
+    def test_second_window_rescues_slow_init(self, _fresh, monkeypatch):
+        calls = []
+
+        def fake_run(*a, **kw):
+            calls.append(1)
+            if len(calls) == 1:
+                raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
+            return subprocess.CompletedProcess(a, returncode=0)
+
+        monkeypatch.setattr(device_probe.subprocess, "run", fake_run)
+        assert device_probe.ensure_responsive_backend(timeout_s=0.01) == "ok"
+        assert len(calls) == 2
