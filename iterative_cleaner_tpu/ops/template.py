@@ -12,6 +12,8 @@ want true f32 accumulation, not bf16 MXU passes.
 
 from __future__ import annotations
 
+import os
+
 import jax.numpy as jnp
 from jax import lax
 
@@ -22,12 +24,34 @@ from iterative_cleaner_tpu.config import (
 
 _PREC = lax.Precision.HIGHEST
 
+# r03 on-chip phase telemetry measured the einsum lowering of the template
+# reduction at 15.8 GB/s — 68 ms of a 146 ms step for one cube read
+# (docs/bench_r03_interim.json; the two-contracting-dim dot is the suspected
+# pathology).  The multiply-reduce form is a fused VPU reduction with the
+# bin axis minor — the predictable bandwidth-bound lowering.  Flag masks are
+# invariant to the switch across the fuzz corpus in every execution mode
+# (reduction-order changes in the template never flip a >=-threshold
+# decision; the TPU einsum already differed bitwise from the numpy oracle's
+# and masks held).  ICT_TEMPLATE_LOWERING={mulreduce,matvec,einsum} selects
+# at import for A/B measurement (tools/probe_template_perf.py).
+_LOWERING = os.environ.get("ICT_TEMPLATE_LOWERING", "mulreduce")
+if _LOWERING not in ("mulreduce", "matvec", "einsum"):
+    raise ValueError(
+        f"ICT_TEMPLATE_LOWERING={_LOWERING!r}: expected one of "
+        "'mulreduce', 'matvec', 'einsum' (a typo here would silently "
+        "mislabel an A/B measurement)")
+
 
 def build_template(D: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
     """Weighted scrunch over (subint, channel): PSRCHIVE's fscrunch+tscrunch
     collapse up to overall scale, which cancels out of amp·t (§8.L7 — the
     reference's ×10000 included)."""
-    return jnp.einsum("sc,scb->b", weights, D, precision=_PREC)
+    if _LOWERING == "einsum":
+        return jnp.einsum("sc,scb->b", weights, D, precision=_PREC)
+    if _LOWERING == "matvec":
+        return jnp.matmul(
+            weights.reshape(-1), D.reshape(-1, D.shape[-1]), precision=_PREC)
+    return jnp.sum(weights[..., None] * D, axis=(0, 1))
 
 
 def fit_and_subtract(

@@ -13,11 +13,14 @@ in-memory path so the semantics cannot drift:
 
 1. **template pass** — the weighted profile scrunch
    (:func:`..ops.template.build_template`) is a sum over profiles, so each
-   block contributes a partial ``einsum('sc,scb->b')`` accumulated on device.
-   (Block-wise accumulation reorders the f32 sum relative to the monolithic
-   einsum; the masks are insensitive to the ~1 ulp template wobble —
-   pinned by ``tests/test_chunked.py`` — but bit-identity of intermediate
-   template values to the in-memory path is not guaranteed.)
+   block contributes a partial via the *same* ``build_template`` lowering
+   as the in-memory path, accumulated on device.  (Block-wise accumulation
+   reorders the f32 sum relative to the monolithic reduction; the masks
+   are insensitive to the resulting few-ulp template wobble — per-element
+   score drift up to ~5e-5 relative, pinned by ``tests/test_chunked.py`` —
+   but bit-identity of intermediate template/score values to the in-memory
+   path is not guaranteed for partial blocks.  A single-block stream has no
+   reordering and is bit-exact throughout.)
 2. **stats pass** — per block: closed-form fit + residual
    (:func:`..ops.template.fit_and_subtract`), weight pre-scaling, and the
    four per-profile diagnostics (:func:`..ops.stats.diagnostics`) — all
@@ -50,15 +53,16 @@ import numpy as np
 
 from iterative_cleaner_tpu.config import CleanConfig
 from iterative_cleaner_tpu.ops.stats import diagnostics, scale_and_combine
-from iterative_cleaner_tpu.ops.template import fit_and_subtract
-
-_PREC = jax.lax.Precision.HIGHEST
+from iterative_cleaner_tpu.ops.template import build_template, fit_and_subtract
 
 
 @jax.jit
 def _partial_template(Dblk, wblk):
-    """One block's contribution to the weighted profile scrunch."""
-    return jnp.einsum("sc,scb->b", wblk, Dblk, precision=_PREC)
+    """One block's contribution to the weighted profile scrunch — the same
+    lowering as the in-memory ``build_template`` so a single-block stream is
+    bit-identical to the in-memory path (multi-block accumulation reorders
+    the sum either way; ~ulp score wobble, module docstring)."""
+    return build_template(Dblk, wblk)
 
 
 @partial(jax.jit, static_argnames=("pulse_region", "want_resid"))

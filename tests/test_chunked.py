@@ -33,7 +33,9 @@ def test_chunked_step_matches_in_memory(block):
     np.testing.assert_array_equal(w_c, w_m)
     fin = np.isfinite(test_m)
     assert (np.isnan(test_c) == np.isnan(test_m)).all()
-    np.testing.assert_allclose(test_c[fin], test_m[fin], rtol=1e-5)
+    # A few f32 ulps of wobble: the multiply-reduce template lowering's
+    # block partials reorder slightly more than the old einsum partials did.
+    np.testing.assert_allclose(test_c[fin], test_m[fin], rtol=5e-5)
     if block == 8:
         np.testing.assert_array_equal(test_c, test_m)
 
