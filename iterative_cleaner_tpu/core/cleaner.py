@@ -41,6 +41,9 @@ class CleanResult:
     iterations: list[IterationInfo] = field(default_factory=list)
     history: list[np.ndarray] = field(default_factory=list)
     residual: np.ndarray | None = None   # unweighted amp*t − D, dedispersed frame
+    timed: bool = False                  # iterations carry real host wall-clock
+                                         # laps (stepwise loops; the fused
+                                         # single dispatch has none)
 
     @property
     def rfi_frac(self) -> float:
@@ -227,6 +230,7 @@ def clean_cube(
         iterations=infos,
         history=history,
         residual=residual,
+        timed=True,
     )
 
 

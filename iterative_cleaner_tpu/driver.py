@@ -13,7 +13,7 @@ from __future__ import annotations
 import datetime
 import os
 import sys
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from iterative_cleaner_tpu.config import CleanConfig
 from iterative_cleaner_tpu.io.base import Archive, get_io, known_extension as _ext
@@ -54,6 +54,10 @@ class ArchiveReport:
     converged: bool = False
     error: str | None = None
     skipped: bool = False          # --resume: output already existed
+    # Host wall-clock per iteration (stepwise paths; --fused is one device
+    # dispatch and the sharded batch one per bucket, so both leave this
+    # empty rather than reporting zeros).
+    iteration_s: list[float] = field(default_factory=list)
 
 
 def split_resumable(paths: list[str], cfg: CleanConfig):
@@ -135,6 +139,7 @@ def emit_outputs(
     log_dir: str,
     all_paths: list[str],
     history=None,
+    iteration_s: list[float] | None = None,
 ) -> ArchiveReport:
     """The side-output block shared by the sequential and sharded-batch
     drivers: save, zap plot, mask dump, clean.log line, report."""
@@ -170,6 +175,7 @@ def emit_outputs(
         loops=loops,
         rfi_frac=rfi_frac,
         converged=converged,
+        iteration_s=iteration_s or [],
     )
 
 
@@ -235,6 +241,11 @@ def process_archive(
         log_dir,
         all_paths if all_paths is not None else [path],
         history=res.history,
+        # The fused single-dispatch loop has no per-iteration host laps;
+        # its result says so (timed=False) — report nothing for it rather
+        # than a list of zeros.
+        iteration_s=[i.duration_s for i in res.iterations] if res.timed
+        else None,
     )
 
 

@@ -21,14 +21,16 @@ def profile_trace(trace_dir: str | None):
 
 
 class StepTimer:
-    """Wall-clock per iteration, reported through the progress callback."""
+    """Wall-clock per iteration, reported through the progress callback.
+    perf_counter: monotonic (no negative laps on wall-clock steps) and
+    high-resolution (no 0.0 laps on coarse system clocks)."""
 
     def __init__(self) -> None:
-        self._t0 = time.time()
+        self._t0 = time.perf_counter()
         self.durations: list[float] = []
 
     def lap(self) -> float:
-        now = time.time()
+        now = time.perf_counter()
         dt = now - self._t0
         self._t0 = now
         self.durations.append(dt)

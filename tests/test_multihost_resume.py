@@ -268,6 +268,10 @@ class TestResume:
             rep = json.load(fh)
         assert [r["error"] is None for r in rep] == [True, True, False]
         assert rep[0]["loops"] >= 1 and rep[0]["out_path"].endswith("_cleaned.npz")
+        # Stepwise runs carry per-iteration host wall-clock in the report
+        # (perf_counter laps: monotonic, so never negative).
+        assert len(rep[0]["iteration_s"]) >= rep[0]["loops"]
+        assert all(t >= 0 for t in rep[0]["iteration_s"])
         assert 0.0 <= rep[0]["rfi_frac"] <= 1.0
 
     def test_resume_with_explicit_output_warns_and_runs(self, tmp_path, monkeypatch, capsys):
