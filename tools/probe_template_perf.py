@@ -13,12 +13,15 @@ default backend is the real TPU behind the axon tunnel).
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
 import numpy as np
 
-NSUB, NCHAN, NBIN = 256, 1024, 1024
+NSUB = int(os.environ.get("PROBE_NSUB", 256))
+NCHAN = int(os.environ.get("PROBE_NCHAN", 1024))
+NBIN = int(os.environ.get("PROBE_NBIN", 1024))
 
 
 def _force(x):
@@ -31,9 +34,9 @@ def _t(fn, n=5):
     fn()  # compile
     times = []
     for _ in range(n):
-        t0 = time.time()
+        t0 = time.perf_counter()  # monotonic: sub-ms laps stay reliable
         fn()
-        times.append(time.time() - t0)
+        times.append(time.perf_counter() - t0)
     return min(times)
 
 
