@@ -135,3 +135,25 @@ def test_fused_requires_jax_backend():
     D, w0 = preprocess(ar)
     with pytest.raises(ValueError):
         clean_cube(D, w0, CleanConfig(backend="numpy", fused=True))
+
+
+@pytest.mark.parametrize("case", ["posinf", "neginf", "mixed"])
+def test_masks_identical_with_inf_samples(case):
+    """Saturated (±inf) samples — e.g. clipped digitizer levels — poison
+    means/FFTs to NaN/inf in both backends identically; the mask decision
+    (NaN >= 1 is False, §8.L3) must agree bit-for-bit."""
+    archive = make_archive(nsub=8, nchan=32, nbin=128, seed=77)
+    D, w0 = preprocess(archive)
+    D = np.array(D)
+    if case == "posinf":
+        D[2, 5, 10] = np.inf
+    elif case == "neginf":
+        D[3, 7, :4] = -np.inf
+    else:
+        D[1, 2, 0], D[1, 2, 1] = np.inf, -np.inf
+    with np.errstate(invalid="ignore"):
+        res_np = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=4))
+    res_jx = clean_cube(
+        D, w0, CleanConfig(backend="jax", fused=True, max_iter=4))
+    np.testing.assert_array_equal(res_np.weights, res_jx.weights)
+    assert res_np.loops == res_jx.loops
