@@ -101,6 +101,16 @@ def clean_cube(
     the single-device chunked backend instead (parallel/chunked.py) — a
     stepwise path, so progress / history / residual all keep working.
     """
+    if cfg.backend == "jax" and D.shape[-1] < 3:
+        import warnings
+
+        warnings.warn(
+            "mask parity vs the numpy oracle is not guaranteed below 3 "
+            "phase bins: numpy.ma computes a mixed f32/f64 diagnostic "
+            "pipeline (3 of 4 promoted to f64) and a centred 2-bin profile "
+            "is structurally tied, so the device pipeline's MAD/tie "
+            "classifications can flip at any uniform precision — f32 "
+            "default and --x64 alike (SURVEY.md §8.L9)", stacklevel=2)
     chunk_block = None
     chunk_why = ""
     if cfg.backend == "jax" and cfg.chunk_block:

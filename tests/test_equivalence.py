@@ -157,3 +157,29 @@ def test_masks_identical_with_inf_samples(case):
         D, w0, CleanConfig(backend="jax", fused=True, max_iter=4))
     np.testing.assert_array_equal(res_np.weights, res_jx.weights)
     assert res_np.loops == res_jx.loops
+
+
+@pytest.mark.parametrize("nbin", [3, 4, 6])
+def test_masks_identical_tiny_nbin(nbin):
+    """The parity domain boundary (SURVEY §8.L9, corrected r03): the oracle
+    computes 3 of the 4 diagnostics in f64 (numpy.ma promotion), yet masks
+    agree with the f32 device pipeline for every nbin >= 3.  (nbin == 2 is
+    structurally tied — centred 2-bin profiles are exactly antisymmetric —
+    and diverges by design; the jax path warns, see test below.)"""
+    archive = make_archive(nsub=5, nchan=16, nbin=nbin, seed=31,
+                           rfi=RFISpec(2, 1, 0, 0, 1))
+    D, w0 = preprocess(archive)
+    with np.errstate(all="ignore"):
+        res_np = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=4))
+    res_jx = clean_cube(
+        D, w0, CleanConfig(backend="jax", fused=True, max_iter=4))
+    np.testing.assert_array_equal(res_np.weights, res_jx.weights)
+    assert res_np.loops == res_jx.loops
+
+
+def test_nbin_below_parity_domain_warns():
+    archive = make_archive(nsub=3, nchan=8, nbin=2, seed=9,
+                           rfi=RFISpec(1, 0, 0, 0, 0))
+    D, w0 = preprocess(archive)
+    with pytest.warns(UserWarning, match="below 3 phase bins"):
+        clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1))
