@@ -183,3 +183,23 @@ def test_nbin_below_parity_domain_warns():
     D, w0 = preprocess(archive)
     with pytest.warns(UserWarning, match="below 3 phase bins"):
         clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1))
+
+
+def test_masks_identical_dead_channels_and_subints():
+    """Dead hardware inside real data — exactly-constant channels/subints
+    (including at 0.0) — is the realistic MAD=0 regime and must stay
+    mask-identical.  (A whole exactly-constant CUBE is excluded from the
+    parity domain: its residuals are pure rounding noise — SURVEY §8.L9.)"""
+    archive = make_archive(nsub=6, nchan=24, nbin=64, seed=4,
+                           rfi=RFISpec(2, 1, 1, 1, 2))
+    D, w0 = preprocess(archive)
+    D = np.array(D)
+    D[:, 7, :] = 4.5
+    D[2, :, :] = -1.25
+    D[:, 9, :] = 0.0
+    with np.errstate(all="ignore"):
+        res_np = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=5))
+    res_jx = clean_cube(
+        D, w0, CleanConfig(backend="jax", fused=True, max_iter=5))
+    np.testing.assert_array_equal(res_np.weights, res_jx.weights)
+    assert res_np.loops == res_jx.loops
