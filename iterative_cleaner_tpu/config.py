@@ -38,6 +38,21 @@ def pulse_region_bin_scale(nbin: int, pulse_region, dtype="float32"):
     return bin_scale
 
 
+def warn_zero_threshold(stacklevel: int = 2) -> None:
+    """Shared by CleanConfig validation and the --sweep grid check: the
+    reference accepts thresh=0 (every |scaled|/0 becomes inf/NaN and
+    essentially everything unmasked is zapped), so we do too — but 0/0 ties
+    break differently between numpy.ma's mixed f32/f64 pipeline and the
+    device's uniform dtype, so the bit-identical-mask guarantee does not
+    cover it."""
+    import warnings
+
+    warnings.warn(
+        "a threshold of exactly 0 divides every scaled diagnostic by zero; "
+        "results are degenerate and mask parity vs the numpy oracle is not "
+        "guaranteed", stacklevel=stacklevel + 1)
+
+
 @dataclass(frozen=True)
 class CleanConfig:
     # --- algorithm parameters (reference flags) ---
@@ -79,6 +94,8 @@ class CleanConfig:
             # max_iter == 0 (reference iterative_cleaner.py:152; SURVEY.md
             # §8.L10). We reject it up front instead.
             raise ValueError(f"max_iter must be >= 1, got {self.max_iter}")
+        if self.chanthresh == 0 or self.subintthresh == 0:
+            warn_zero_threshold(stacklevel=3)  # through the generated __init__
         if self.backend not in ("numpy", "jax"):
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.fused and self.backend != "jax":

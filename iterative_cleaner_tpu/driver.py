@@ -359,12 +359,18 @@ def run_sweep(
     """--sweep mode: per archive, run the whole threshold grid as one
     batched device dispatch (models/sweep.py), print the table, save
     ``<path>_sweep.npz``.  Exploratory — no cleaned archives, no clean.log."""
+    from iterative_cleaner_tpu.config import warn_zero_threshold
     from iterative_cleaner_tpu.models.sweep import (
         format_table,
         save_sweep,
         sweep_thresholds,
     )
     from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+    if any(c == 0 or s == 0 for c, s in pairs):
+        # Sweep thresholds are traced scalars that never pass through a
+        # CleanConfig, so the degenerate-threshold check fires here.
+        warn_zero_threshold()
 
     if cfg.backend != "jax":
         print("error: --sweep requires --backend=jax", file=sys.stderr)

@@ -203,3 +203,32 @@ def test_masks_identical_dead_channels_and_subints():
         D, w0, CleanConfig(backend="jax", fused=True, max_iter=5))
     np.testing.assert_array_equal(res_np.weights, res_jx.weights)
     assert res_np.loops == res_jx.loops
+
+
+@pytest.mark.parametrize("thresh_kw", [
+    dict(chanthresh=0.1, subintthresh=0.1),
+    dict(chanthresh=1e9, subintthresh=1e9),
+    dict(chanthresh=-5.0, subintthresh=-5.0),
+])
+def test_masks_identical_threshold_extremes(thresh_kw):
+    """Tiny, huge, and negative thresholds stay inside the parity domain
+    (negative thresholds flip the sign of every scaled diagnostic the same
+    way in both backends).  Exactly-zero thresholds are excluded — 0/0 ties
+    break by dtype — and warn at config time (see below)."""
+    archive = make_archive(nsub=6, nchan=24, nbin=64, seed=5,
+                           rfi=RFISpec(2, 1, 1, 0, 2))
+    D, w0 = preprocess(archive)
+    with np.errstate(all="ignore"):
+        res_np = clean_cube(
+            D, w0, CleanConfig(backend="numpy", max_iter=4, **thresh_kw))
+    res_jx = clean_cube(
+        D, w0, CleanConfig(backend="jax", fused=True, max_iter=4, **thresh_kw))
+    np.testing.assert_array_equal(res_np.weights, res_jx.weights)
+    assert res_np.loops == res_jx.loops
+
+
+def test_zero_threshold_warns():
+    with pytest.warns(UserWarning, match="threshold of exactly 0"):
+        CleanConfig(chanthresh=0.0)
+    with pytest.warns(UserWarning, match="threshold of exactly 0"):
+        CleanConfig(subintthresh=0.0)

@@ -121,3 +121,17 @@ def test_cli_sweep_bad_pair(tmp_path):
     p = str(tmp_path / "a.npz")
     NpzIO().save(make_archive(nsub=4, nchan=8, nbin=32, seed=142), p)
     assert main([p, "--sweep", "nonsense"]) == 2
+
+
+def test_sweep_zero_pair_warns(tmp_path, monkeypatch):
+    """Sweep thresholds are traced scalars that never pass through a
+    CleanConfig; the degenerate-threshold parity warning must still fire."""
+    import pytest
+
+    from iterative_cleaner_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    p = str(tmp_path / "a.npz")
+    NpzIO().save(make_archive(nsub=4, nchan=8, nbin=32, seed=143), p)
+    with pytest.warns(UserWarning, match="threshold of exactly 0"):
+        assert main([p, "--backend=jax", "--sweep", "0:5", "5:5"]) == 0
