@@ -232,3 +232,26 @@ def test_zero_threshold_warns():
         CleanConfig(chanthresh=0.0)
     with pytest.warns(UserWarning, match="threshold of exactly 0"):
         CleanConfig(subintthresh=0.0)
+
+
+@pytest.mark.parametrize("case", ["sample", "subint", "weight"])
+def test_masks_identical_with_nan_inputs(case):
+    """NaN samples (dropouts) and NaN weights flow through both pipelines
+    identically: NaN-poisoned scores never flag (§8.L3), and a NaN weight
+    survives into the output weights of both backends at the same spot."""
+    archive = make_archive(nsub=6, nchan=24, nbin=64, seed=5,
+                           rfi=RFISpec(2, 1, 1, 0, 2))
+    D, w0 = preprocess(archive)
+    D, w0 = np.array(D), np.array(w0)
+    if case == "sample":
+        D[1, 4, 10] = np.nan
+    elif case == "subint":
+        D[3, :, :] = np.nan
+    else:
+        w0[2, 6] = np.nan
+    with np.errstate(all="ignore"):
+        res_np = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=4))
+    res_jx = clean_cube(
+        D, w0, CleanConfig(backend="jax", fused=True, max_iter=4))
+    assert np.array_equal(res_np.weights, res_jx.weights, equal_nan=True)
+    assert res_np.loops == res_jx.loops
