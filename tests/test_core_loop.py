@@ -90,3 +90,25 @@ class TestFindBadParts:
         assert nc == 0
         out2, _, nc2 = find_bad_parts(w, _cfg(bad_subint=0.9, bad_chan=0.4))
         assert nc2 == 1 and np.all(out2[:, 0] == 0)
+
+    def test_fraction_zero_zaps_any_partial_line(self):
+        # bad_subint=0: any subint with >0 zapped fraction goes (strictly
+        # greater, so a fully-clean line survives even at threshold 0).
+        w = np.ones((3, 4), np.float32)
+        w[0, 1] = 0.0
+        out, ns, nc = find_bad_parts(w, _cfg(bad_subint=0.0, bad_chan=0.0))
+        assert ns == 1 and np.all(out[0] == 0)
+        # channel 1's snapshot fraction is 1/3 > 0 -> zapped too
+        assert nc == 1 and np.all(out[:, 1] == 0)
+        # untouched lines survive
+        assert out[1:, [0, 2, 3]].all()
+
+    def test_fraction_above_one_is_noop(self):
+        w = np.zeros((3, 4), np.float32)  # everything zapped: frac = 1.0
+        out, ns, nc = find_bad_parts(w, _cfg(bad_subint=1.5, bad_chan=2.0))
+        assert (ns, nc) == (0, 0)  # 1.0 > 1.5 is False
+
+    def test_negative_fraction_zaps_everything(self):
+        w = np.ones((3, 4), np.float32)  # nothing zapped: frac = 0.0
+        out, ns, nc = find_bad_parts(w, _cfg(bad_subint=-0.1, bad_chan=-0.1))
+        assert ns == 3 and nc == 4 and not out.any()  # 0.0 > -0.1
