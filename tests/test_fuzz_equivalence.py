@@ -22,7 +22,9 @@ def draw_case(seed: int):
     rng = np.random.default_rng(seed)
     nsub = int(rng.integers(3, 13))
     nchan = int(rng.integers(8, 40))
-    nbin = int(rng.choice([32, 64, 100, 128]))
+    # Tiny bin counts down to the parity-domain edge (nbin >= 3, SURVEY
+    # §8.L9) — edge-probing showed the dtype-tie risk lives there.
+    nbin = int(rng.choice([3, 4, 8, 16, 32, 64, 100, 128]))
     rfi = RFISpec(
         n_profile_spikes=int(rng.integers(0, 6)),
         n_dc_profiles=int(rng.integers(0, 4)),
@@ -36,6 +38,13 @@ def draw_case(seed: int):
         snr=float(rng.uniform(5.0, 60.0)), rfi=rfi,
         dispersed=bool(rng.random() < 0.8),
     )
+    D = archive.data
+    if rng.random() < 0.25:
+        # Dead hardware: an exactly-constant channel (and sometimes subint)
+        # inside otherwise-real data — the realistic MAD=0 regime.
+        D[:, :, int(rng.integers(0, nchan)), :] = float(rng.uniform(-3, 3))
+    if rng.random() < 0.15:
+        D[int(rng.integers(0, nsub))] = float(rng.uniform(-3, 3))
     if rng.random() < 0.3:
         pulse_region = (float(rng.uniform(0.0, 2.0)),
                         float(rng.integers(0, nbin // 2)),
