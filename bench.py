@@ -553,6 +553,14 @@ def _bench_chunked(state, upload_gbps: float) -> dict:
 def run_bench() -> dict:
     dev = _init_device()
     _PAYLOAD["device"] = f"{dev.platform}:{dev.device_kind}"
+    import jax
+
+    from iterative_cleaner_tpu.ops.template import _LOWERING
+
+    # Self-describing artifact: which template lowering and stack produced
+    # these numbers (ICT_TEMPLATE_LOWERING selects for A/B runs).
+    _PAYLOAD["template_lowering"] = _LOWERING
+    _PAYLOAD["jax_version"] = jax.__version__
 
     from iterative_cleaner_tpu.config import CleanConfig
     from iterative_cleaner_tpu.core.cleaner import clean_cube
