@@ -646,6 +646,13 @@ def run_bench() -> dict:
         "upload runs through a dev tunnel at ~tens of MB/s; a real TPU host "
         "moves GB/s over PCIe — see the "
         "end_to_end_speedup_projected_real_host_{cold,warm} keys")
+    if _PAYLOAD.get("tpu_unreachable"):
+        # Degraded CPU-fallback artifact: point the reader at the most
+        # recent real-TPU run checked into the repo.
+        interim = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "docs", "bench_r03_interim.json")
+        if os.path.exists(interim):
+            _PAYLOAD["last_real_tpu_artifact"] = "docs/bench_r03_interim.json"
     return _PAYLOAD
 
 
