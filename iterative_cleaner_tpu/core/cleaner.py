@@ -111,6 +111,31 @@ def clean_cube(
             "is structurally tied, so the device pipeline's MAD/tie "
             "classifications can flip at any uniform precision — f32 "
             "default and --x64 alike (SURVEY.md §8.L9)", stacklevel=2)
+    if cfg.backend == "jax":
+        # Dynamic-range bound of the parity guarantee: beyond ~sqrt(f32max)
+        # the oracle's MIXED pipeline bifurcates — its f32 fit overflows
+        # <t,t> to inf (degenerate amp=1 branch) while its f64-promoted
+        # ma.std stays finite — a combination no uniform-precision device
+        # pipeline (f32 default or --x64) reproduces (SURVEY §8.L9).
+        # min/max instead of abs().max(): no copy of a possibly >HBM cube.
+        # nanmin/nanmax so a stray NaN cannot silently suppress the check
+        # for a co-present finite spike (still copy-free on a >HBM cube).
+        peak = max(-float(np.nanmin(D)), float(np.nanmax(D))) * max(
+            1.0, abs(float(np.nanmax(w0))), abs(float(np.nanmin(w0))))
+        # Only FINITE magnitudes in the overflow band bifurcate the mixed
+        # pipeline; ±inf/NaN inputs poison both pipelines identically
+        # (pinned by test_masks_identical_with_inf_samples) and stay quiet —
+        # the one blind spot is an inf sample coexisting with a finite
+        # overflow-band spike, undetectable without a filtered second pass.
+        if np.isfinite(peak) and peak > 1e17:
+            import warnings
+
+            warnings.warn(
+                f"data magnitude ~{peak:.1e} approaches the f32 dynamic "
+                "range (squared residuals overflow beyond ~1.8e19, and the "
+                "oracle's mixed f32/f64 pipeline bifurcates there); mask "
+                "parity is not guaranteed at any device precision — inspect "
+                "the input for corruption (SURVEY.md §8.L9)", stacklevel=2)
     chunk_block = None
     chunk_why = ""
     if cfg.backend == "jax" and cfg.chunk_block:

@@ -255,3 +255,42 @@ def test_masks_identical_with_nan_inputs(case):
         D, w0, CleanConfig(backend="jax", fused=True, max_iter=4))
     assert np.array_equal(res_np.weights, res_jx.weights, equal_nan=True)
     assert res_np.loops == res_jx.loops
+
+
+def test_masks_identical_tiny_scale_data():
+    """1e-30-scale data (underflow-adjacent) stays inside the parity
+    domain; the huge-magnitude end (~>1e17) does not — the oracle's mixed
+    f32/f64 pipeline bifurcates there (SURVEY §8.L9) and the jax path
+    warns (see below)."""
+    archive = make_archive(nsub=6, nchan=24, nbin=64, seed=5,
+                           rfi=RFISpec(2, 1, 1, 0, 2))
+    D, w0 = preprocess(archive)
+    D = np.array(D) * np.float32(1e-30)
+    with np.errstate(all="ignore"):
+        res_np = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=4))
+    res_jx = clean_cube(
+        D, w0, CleanConfig(backend="jax", fused=True, max_iter=4))
+    np.testing.assert_array_equal(res_np.weights, res_jx.weights)
+    assert res_np.loops == res_jx.loops
+
+
+def test_huge_magnitude_warns():
+    archive = make_archive(nsub=4, nchan=8, nbin=32, seed=5)
+    D, w0 = preprocess(archive)
+    D = np.array(D)
+    D[1, 2, 3] = 1e30
+    with pytest.warns(UserWarning, match="f32 dynamic range"):
+        clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1))
+
+
+def test_huge_magnitude_warns_despite_nan():
+    """A stray NaN must not suppress the dynamic-range warning for a
+    co-present finite overflow-band spike."""
+    archive = make_archive(nsub=4, nchan=8, nbin=32, seed=5)
+    D, w0 = preprocess(archive)
+    D = np.array(D)
+    D[0, 0, 0] = np.nan
+    D[1, 2, 3] = 1e30
+    with pytest.warns(UserWarning, match="f32 dynamic range"):
+        with np.errstate(all="ignore"):
+            clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1))
