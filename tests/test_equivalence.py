@@ -294,3 +294,27 @@ def test_huge_magnitude_warns_despite_nan():
     with pytest.warns(UserWarning, match="f32 dynamic range"):
         with np.errstate(all="ignore"):
             clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1))
+
+
+@pytest.mark.parametrize("pr", [
+    (0.5, 50.0, 100.0),   # end beyond nbin: Python slice clamps
+    (0.5, 100.0, 120.0),  # both beyond: empty slice, no-op
+    (0.5, -20.0, 40.0),   # negative start wraps from the end
+    (0.5, 10.0, -5.0),    # negative end wraps (10..nbin-5)
+    (0.5, 40.0, 10.0),    # start > end: empty slice, no-op
+])
+def test_masks_identical_pulse_region_boundaries(pr):
+    """The oracle applies pulse_region with real Python slice semantics
+    (clamping, negative-index wrapping, empty slices — reference
+    iterative_cleaner.py:279-282); the device path's static bin scale must
+    replicate them exactly."""
+    archive = make_archive(nsub=6, nchan=24, nbin=64, seed=5,
+                           rfi=RFISpec(2, 1, 1, 0, 2))
+    D, w0 = preprocess(archive)
+    res_np = clean_cube(
+        D, w0, CleanConfig(backend="numpy", max_iter=3, pulse_region=pr))
+    res_jx = clean_cube(
+        D, w0, CleanConfig(backend="jax", fused=True, max_iter=3,
+                           pulse_region=pr))
+    np.testing.assert_array_equal(res_np.weights, res_jx.weights)
+    assert res_np.loops == res_jx.loops
