@@ -5,12 +5,15 @@ Two shape classes, both with flag-mask parity checks along the way:
 - **config A** (BASELINE.md config #2 class): 256 subint x 1024 chan x 1024
   bin (1.07 GB f32).  Full numpy ``clean()`` measured end-to-end, fused JAX
   loop cold + warm, per-phase device timings with an HBM-bandwidth model,
-  the compiled Pallas arm, and the single-chip chunked (>HBM) arm.
+  and the compiled Pallas arm.
 - **config B** (the BASELINE.md north-star shape class): 1024 subint x 4096
   chan x 256 bin (4.3 GB f32) — the 1024x4096 profile grid of the north
   star at an nbin whose working set fits one v5e chip.  numpy is measured
   for one step and extrapolated (its per-iteration cost is
   iteration-invariant); JAX is measured end-to-end.
+- the single-chip chunked (>HBM) arm runs LAST: its tunnel-heavy uploads
+  are where the r03 interim run wedged, so sections run in order of data
+  value and a mid-run wedge costs the least.
 
 Prints ONE JSON line on stdout.  Headline metric: **end-to-end** clean()
 speedup at config A — numpy wall-clock / (upload + compile + fused run),
