@@ -111,7 +111,15 @@ def clean_cube(
             "is structurally tied, so the device pipeline's MAD/tie "
             "classifications can flip at any uniform precision — f32 "
             "default and --x64 alike (SURVEY.md §8.L9)", stacklevel=2)
+    import os as _os
+
     if cfg.backend == "jax":
+        try:
+            scan_cap = float(
+                _os.environ.get("ICT_PARITY_SCAN_MAX_BYTES", 4e9))
+        except ValueError:
+            scan_cap = 4e9  # malformed knob: advisory scan, not a crash
+    if cfg.backend == "jax" and D.nbytes <= scan_cap:
         # Dynamic-range bound of the parity guarantee: beyond ~sqrt(f32max)
         # the oracle's MIXED pipeline bifurcates — its f32 fit overflows
         # <t,t> to inf (degenerate amp=1 branch) while its f64-promoted
@@ -120,6 +128,12 @@ def clean_cube(
         # min/max instead of abs().max(): no copy of a possibly >HBM cube.
         # nanmin/nanmax so a stray NaN cannot silently suppress the check
         # for a co-present finite spike (still copy-free on a >HBM cube).
+        # The scan is two sequential host passes over the cube, so it is
+        # capped (ICT_PARITY_SCAN_MAX_BYTES, default 4 GB; raise or 'inf' to
+        # scan always): on the >HBM chunked route it would otherwise add a
+        # multi-GB host scan per archive purely to decide a warning —
+        # corruption at that magnitude (>1e17) is vanishingly rare in real
+        # f32 archives and the warning is advisory, not load-bearing.
         peak = max(-float(np.nanmin(D)), float(np.nanmax(D))) * max(
             1.0, abs(float(np.nanmax(w0))), abs(float(np.nanmin(w0))))
         # Only FINITE magnitudes in the overflow band bifurcate the mixed
@@ -152,7 +166,8 @@ def clean_cube(
 
         sharded = maybe_clean_sharded(D, w0, cfg, want_residual)
         if sharded is not None:
-            note_compiled_shape(tuple(D.shape))
+            note_compiled_shape(
+                (*D.shape, "sharded", cfg.x64, want_residual))
             return sharded
         chunk_block = chunk_block_subints(D.shape, cfg)
         chunk_why = f"cube {tuple(D.shape)} exceeds device memory"
@@ -176,17 +191,33 @@ def clean_cube(
             f"{' (' + '; '.join(notes) + ')' if notes else ''}",
             file=sys.stderr)
 
+    if want_residual and cfg.pallas:
+        # The Pallas kernel does not materialise the residual; fall back to
+        # the XLA route for this request (resolved BEFORE the compile-cache
+        # key below so the key matches the executable actually compiled;
+        # run_fused applies the same fallback internally).
+        cfg = cfg.replace(pallas=False)
+
     if cfg.backend == "jax":
         nsub, nchan, nbin = D.shape
+        # Keys carry a route fingerprint (route + the config axes that
+        # compile distinct executable sets: pallas is a static jit argname on
+        # the fused kernel and selects a different block-stats path on the
+        # chunked route) because the empirical ~70-compile segfault budget is
+        # per executable, not per cube shape.
         if chunk_block is not None:
             # Chunked executables are keyed by the block slab shape, not the
             # cube: distinct-nsub cubes sharing one block size reuse one
             # executable set and must not count as distinct shapes.
-            note_compiled_shape((min(chunk_block, nsub), nchan, nbin))
+            fp = ("chunked", cfg.pallas, cfg.x64, want_residual)
+            note_compiled_shape((min(chunk_block, nsub), nchan, nbin, *fp))
             if nsub > chunk_block and nsub % chunk_block:
-                note_compiled_shape((nsub % chunk_block, nchan, nbin))
+                note_compiled_shape((nsub % chunk_block, nchan, nbin, *fp))
         else:
-            note_compiled_shape((nsub, nchan, nbin))
+            route = "fused" if cfg.fused else "stepwise"
+            note_compiled_shape(
+                (nsub, nchan, nbin, route, cfg.pallas, cfg.x64,
+                 want_residual))
 
     if cfg.fused and chunk_block is None:
         from iterative_cleaner_tpu.backends.jax_backend import run_fused
@@ -210,10 +241,6 @@ def clean_cube(
             residual=out[6] if want_residual else None,
         )
 
-    if want_residual and cfg.pallas:
-        # The Pallas kernel does not materialise the residual; fall back to
-        # the XLA route for this request, exactly as run_fused does.
-        cfg = cfg.replace(pallas=False)
     if chunk_block is not None:
         from iterative_cleaner_tpu.parallel.chunked import ChunkedJaxCleaner
 

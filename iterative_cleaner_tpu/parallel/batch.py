@@ -58,7 +58,7 @@ def _finish_bucket(items, idxs, Db, w0b, cfg, mesh, on_item=None) -> None:
     ``on_item(i, item)`` fires per finished archive — the streaming driver
     emits outputs there and releases the item's host arrays, which is what
     makes its memory bound real."""
-    note_compiled_shape(tuple(Db.shape))
+    note_compiled_shape((*Db.shape, "batch", cfg.x64))
     test_b, w_b, loops_b, done_b = sharded_clean(Db, w0b, cfg, mesh)
     for j, i in enumerate(idxs):
         item = items[i]

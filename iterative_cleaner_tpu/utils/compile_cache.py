@@ -7,10 +7,15 @@ into one process segfaulted the virtual-CPU platform deterministically
 (tools/fuzz_sweep.py works around it with a periodic ``jax.clear_caches()``).
 Real deployments bucket archives by shape (parallel/batch.py) so one process
 rarely sees more than a few shapes — but a heterogeneous-directory workload
-can approach that regime, so the drivers note each shape they are about to
-compile here and the caches are dropped every ``DISTINCT_SHAPE_LIMIT``
-distinct shapes.  A drop only costs a recompile of whatever runs next; live
-device arrays are untouched.
+can approach that regime, so the drivers note each (shape, route fingerprint)
+they are about to compile here and the caches are dropped every
+``DISTINCT_SHAPE_LIMIT`` distinct keys.  The fingerprint (route name plus the
+config axes that compile distinct executable sets: fused/stepwise, x64,
+pallas, want_residual) matters because the ~70-compile budget is per compiled
+*executable*, not per cube shape — one shape can compile several executable
+sets, so a mixed-route workload would exceed the safe cadence well before 20
+bare shapes accumulated.  A drop only costs a recompile of whatever runs
+next; live device arrays are untouched.
 """
 
 from __future__ import annotations
@@ -21,10 +26,10 @@ _seen: set[tuple] = set()
 
 
 def note_compiled_shape(key: tuple) -> bool:
-    """Record a shape key about to be jit-compiled; drop JAX's compilation
-    caches once ``DISTINCT_SHAPE_LIMIT`` distinct keys accumulate.  Returns
-    True when a drop happened (the counter restarts).  Only call on the JAX
-    path — the numpy backend must stay JAX-import-free."""
+    """Record a (shape, route-fingerprint) key about to be jit-compiled; drop
+    JAX's compilation caches once ``DISTINCT_SHAPE_LIMIT`` distinct keys
+    accumulate.  Returns True when a drop happened (the counter restarts).
+    Only call on the JAX path — the numpy backend must stay JAX-import-free."""
     _seen.add(tuple(key))
     if len(_seen) >= DISTINCT_SHAPE_LIMIT:
         import jax

@@ -55,13 +55,30 @@ def pin_cpu_backend() -> None:
         pass
 
 
+_MISSING = object()
+
+
 def _backend_already_live() -> bool:
+    """True when this process's JAX backend is already initialized — the one
+    state where pinning/probing must not run (retargeting a live process
+    would silently move its subsequent dispatches).  Prefers the public-ish
+    ``xla_bridge.backends_are_initialized()``; on attribute drift in a future
+    JAX, fails CLOSED (assume live): a missed probe only costs wedge
+    protection, while a false "not live" would re-pin a process that already
+    holds a TPU backend — the exact silent-retarget hazard this guard
+    exists to prevent."""
     try:
         from jax._src import xla_bridge as _xb
 
-        return bool(getattr(_xb, "_backends", None))
-    except Exception:  # noqa: BLE001 — JAX-version drift: assume not live
-        return False
+        fn = getattr(_xb, "backends_are_initialized", None)
+        if fn is not None:
+            return bool(fn())
+        backends = getattr(_xb, "_backends", _MISSING)
+        if backends is _MISSING:
+            return True  # both signals gone: fail closed
+        return bool(backends)
+    except Exception:  # noqa: BLE001 — JAX-version drift: fail closed
+        return True
 
 
 def _remote_platform_in_play() -> bool:

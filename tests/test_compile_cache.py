@@ -54,7 +54,40 @@ def test_clean_cube_notes_shape_on_jax_path_only(small_archive, monkeypatch):
     clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=1))
     assert seen == []  # numpy path stays JAX-free
     clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1))
-    assert seen == [tuple(D.shape)]
+    # Keys carry a route fingerprint: one cube shape can compile several
+    # executable sets (stepwise/fused/x64/residual), and the ~70-compile
+    # segfault budget is per executable.
+    assert seen == [(*D.shape, "stepwise", False, False, False)]
+    seen.clear()
+    clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1, fused=True))
+    assert seen == [(*D.shape, "fused", False, False, False)]
+
+
+def test_pallas_residual_fallback_keys_as_stepwise(small_archive, monkeypatch):
+    """pallas + want_residual falls back to the XLA route BEFORE keying, so
+    the key matches the executable actually compiled (a 'pallas' key here
+    would double-count one executable set and fire the drop early)."""
+    from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+    seen = []
+    monkeypatch.setattr(
+        "iterative_cleaner_tpu.core.cleaner.note_compiled_shape",
+        lambda key: bool(seen.append(key)))
+    D, w0 = preprocess(small_archive)
+    clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1, pallas=True),
+               want_residual=True)
+    assert seen == [(*D.shape, "stepwise", False, False, True)]
+
+
+def test_malformed_scan_cap_env_does_not_crash(small_archive, monkeypatch):
+    """ICT_PARITY_SCAN_MAX_BYTES is an advisory tuning knob — a shell typo
+    must not turn every clean_cube call into a ValueError."""
+    from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+    monkeypatch.setenv("ICT_PARITY_SCAN_MAX_BYTES", "4GB")
+    D, w0 = preprocess(small_archive)
+    res = clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1))
+    assert res.weights.shape == w0.shape
 
 
 def test_chunked_route_notes_block_shape(small_archive, monkeypatch):
@@ -71,9 +104,10 @@ def test_chunked_route_notes_block_shape(small_archive, monkeypatch):
     nsub, nchan, nbin = D.shape
     block = max(nsub // 2 - 1, 1)  # forces a remainder slab
     clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1, chunk_block=block))
-    expect = [(block, nchan, nbin)]
+    fp = ("chunked", False, False, False)
+    expect = [(block, nchan, nbin, *fp)]
     if nsub > block and nsub % block:
-        expect.append((nsub % block, nchan, nbin))
+        expect.append((nsub % block, nchan, nbin, *fp))
     assert seen == expect
 
 

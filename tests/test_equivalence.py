@@ -283,6 +283,22 @@ def test_huge_magnitude_warns():
         clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1))
 
 
+def test_dynamic_range_scan_gated_by_size_cap(monkeypatch):
+    """The advisory scan is two full host passes over the cube, so it is
+    capped by ICT_PARITY_SCAN_MAX_BYTES (a >HBM chunked-route archive must
+    not pay a multi-GB sequential scan just to decide a warning)."""
+    import warnings
+
+    archive = make_archive(nsub=4, nchan=8, nbin=32, seed=5)
+    D, w0 = preprocess(archive)
+    D = np.array(D)
+    D[1, 2, 3] = 1e30
+    monkeypatch.setenv("ICT_PARITY_SCAN_MAX_BYTES", "0")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any UserWarning would fail
+        clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1))
+
+
 def test_huge_magnitude_warns_despite_nan():
     """A stray NaN must not suppress the dynamic-range warning for a
     co-present finite overflow-band spike."""

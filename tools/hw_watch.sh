@@ -1,0 +1,47 @@
+#!/bin/bash
+# Watch for a healthy TPU-tunnel window; when one opens, run the hardware
+# playbook immediately (probe lowerings A/B, then bench).  The tunnel
+# alternates between healthy windows (~15+ min) and wedged stretches
+# (hours); a wedged tunnel hangs the FIRST jax.devices() process-wide, so
+# every probe runs in a killable subprocess (see CLAUDE.md).
+#
+# Usage: bash tools/hw_watch.sh   (from the repo root; logs to docs/hw_watch.log)
+set -u
+cd "$(dirname "$0")/.."
+LOG=docs/hw_watch.log
+probe() {
+    timeout 75 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null
+}
+note() { echo "$(date -u +%H:%M:%S) $*" >> "$LOG"; }
+
+note "watcher started"
+while true; do
+    plat="$(probe)"
+    if [ "$plat" = "tpu" ]; then
+        note "HEALTHY window open — running playbook"
+        note "probe_template_perf start"
+        timeout 1200 python tools/probe_template_perf.py \
+            > docs/probe_r04_hw.txt 2>&1
+        note "probe_template_perf rc=$?"
+        note "bench (skip chunked) start"
+        BENCH_SKIP_CHUNKED=1 BENCH_WATCHDOG_S=1500 timeout 1800 \
+            python bench.py > docs/bench_r04_hw.json 2> docs/bench_r04_hw.log
+        note "bench rc=$?"
+        # second pass: chunked section only, if the window survived
+        plat2="$(probe)"
+        if [ "$plat2" = "tpu" ]; then
+            note "window still healthy — chunked pass"
+            BENCH_SKIP_NORTHSTAR=1 BENCH_SKIP_PHASES=1 BENCH_SKIP_PALLAS=1 \
+                BENCH_FULL_NUMPY=0 BENCH_WATCHDOG_S=1500 timeout 1800 \
+                python bench.py > docs/bench_r04_hw_chunked.json \
+                2> docs/bench_r04_hw_chunked.log
+            note "chunked bench rc=$?"
+        else
+            note "window closed before chunked pass (plat='$plat2')"
+        fi
+        note "playbook done — watcher exiting"
+        exit 0
+    fi
+    note "wedged (probe='$plat'); sleeping 120s"
+    sleep 120
+done
