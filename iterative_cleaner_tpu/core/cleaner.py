@@ -14,6 +14,7 @@ bookkeeping (iterative_cleaner.py:64-145; SURVEY.md §3.2):
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -111,14 +112,10 @@ def clean_cube(
             "is structurally tied, so the device pipeline's MAD/tie "
             "classifications can flip at any uniform precision — f32 "
             "default and --x64 alike (SURVEY.md §8.L9)", stacklevel=2)
-    import os as _os
-
-    if cfg.backend == "jax":
-        try:
-            scan_cap = float(
-                _os.environ.get("ICT_PARITY_SCAN_MAX_BYTES", 4e9))
-        except ValueError:
-            scan_cap = 4e9  # malformed knob: advisory scan, not a crash
+    try:
+        scan_cap = float(os.environ.get("ICT_PARITY_SCAN_MAX_BYTES", 4e9))
+    except ValueError:
+        scan_cap = 4e9  # malformed knob: advisory scan, not a crash
     if cfg.backend == "jax" and D.nbytes <= scan_cap:
         # Dynamic-range bound of the parity guarantee: beyond ~sqrt(f32max)
         # the oracle's MIXED pipeline bifurcates — its f32 fit overflows
@@ -166,8 +163,11 @@ def clean_cube(
 
         sharded = maybe_clean_sharded(D, w0, cfg, want_residual)
         if sharded is not None:
+            # No x64/want_residual axes (maybe_clean_sharded declines both);
+            # max_iter/pulse_region are statics of the sharded kernel.
             note_compiled_shape(
-                (*D.shape, "sharded", cfg.x64, want_residual))
+                (*D.shape, "sharded", cfg.max_iter,
+                 tuple(cfg.pulse_region)))
             return sharded
         chunk_block = chunk_block_subints(D.shape, cfg)
         chunk_why = f"cube {tuple(D.shape)} exceeds device memory"
@@ -200,24 +200,48 @@ def clean_cube(
 
     if cfg.backend == "jax":
         nsub, nchan, nbin = D.shape
-        # Keys carry a route fingerprint (route + the config axes that
-        # compile distinct executable sets: pallas is a static jit argname on
-        # the fused kernel and selects a different block-stats path on the
-        # chunked route) because the empirical ~70-compile segfault budget is
-        # per executable, not per cube shape.
+        pr = tuple(cfg.pulse_region)
+        # Keys mirror each route's actual static-arg surface (the axes that
+        # compile distinct executable sets) because the empirical ~70-compile
+        # segfault budget is per executable, not per cube shape — an axis the
+        # route does not specialize on would double-count one executable and
+        # fire the cache drop early.
         if chunk_block is not None:
             # Chunked executables are keyed by the block slab shape, not the
             # cube: distinct-nsub cubes sharing one block size reuse one
             # executable set and must not count as distinct shapes.
-            fp = ("chunked", cfg.pallas, cfg.x64, want_residual)
-            note_compiled_shape((min(chunk_block, nsub), nchan, nbin, *fp))
+            # Mirror ChunkedJaxCleaner's runtime demotion so the pallas axis
+            # reflects the executable actually compiled.
+            use_pallas = cfg.pallas
+            if use_pallas:
+                from iterative_cleaner_tpu.ops.pallas_kernels import (
+                    pallas_route_ok,
+                )
+
+                use_pallas = pallas_route_ok(nbin)
+            # The step loop always compiles the want_resid=False variant;
+            # a residual request additionally compiles the want_resid=True
+            # XLA variant in the lazy fetch (chunked.py) — count both.
+            fps = [("chunked", use_pallas, cfg.x64, False, pr)]
+            if want_residual:
+                fps.append(("chunked", False, cfg.x64, True, pr))
+            slabs = [(min(chunk_block, nsub), nchan, nbin)]
             if nsub > chunk_block and nsub % chunk_block:
-                note_compiled_shape((nsub % chunk_block, nchan, nbin, *fp))
-        else:
-            route = "fused" if cfg.fused else "stepwise"
+                slabs.append((nsub % chunk_block, nchan, nbin))
+            for slab in slabs:
+                for fp in fps:
+                    note_compiled_shape((*slab, *fp))
+        elif cfg.fused:
+            # fused_clean statics: max_iter, pulse_region, want_residual,
+            # use_pallas.
             note_compiled_shape(
-                (nsub, nchan, nbin, route, cfg.pallas, cfg.x64,
-                 want_residual))
+                (nsub, nchan, nbin, "fused", cfg.pallas, cfg.x64,
+                 want_residual, cfg.max_iter, pr))
+        else:
+            # clean_step statics are only (pulse_region, use_pallas): the
+            # same executable serves residual and non-residual requests.
+            note_compiled_shape(
+                (nsub, nchan, nbin, "stepwise", cfg.pallas, cfg.x64, pr))
 
     if cfg.fused and chunk_block is None:
         from iterative_cleaner_tpu.backends.jax_backend import run_fused

@@ -58,7 +58,12 @@ def _finish_bucket(items, idxs, Db, w0b, cfg, mesh, on_item=None) -> None:
     ``on_item(i, item)`` fires per finished archive — the streaming driver
     emits outputs there and releases the item's host arrays, which is what
     makes its memory bound real."""
-    note_compiled_shape((*Db.shape, "batch", cfg.x64))
+    # Mirror batched_fused_clean's static-arg surface (max_iter,
+    # pulse_region).  No x64 axis: the batch route has no x64 handling
+    # (preprocess emits f32 and the sharded kernel never casts), so both
+    # cfg.x64 values reuse one executable.
+    note_compiled_shape((*Db.shape, "batch", cfg.max_iter,
+                         tuple(cfg.pulse_region)))
     test_b, w_b, loops_b, done_b = sharded_clean(Db, w0b, cfg, mesh)
     for j, i in enumerate(idxs):
         item = items[i]

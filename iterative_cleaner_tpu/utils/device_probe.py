@@ -41,10 +41,11 @@ def probe_default_backend(timeout_s: float) -> str:
 def pin_cpu_backend() -> None:
     """Pin this process's first backend init to CPU: env (for subprocesses)
     AND config update (beats the plugin registration's stale read).  Leaves a
-    process whose backend is already initialized untouched — first-init is
-    the only moment that can hang, and retargeting a live process would
-    silently move its subsequent dispatches."""
-    if _backend_already_live():
+    process whose backend is already (or possibly) initialized untouched —
+    first-init is the only moment that can hang, and retargeting a live
+    process would silently move its subsequent dispatches, so anything but a
+    definite "not_live" declines to pin (fail closed)."""
+    if _backend_liveness() != "not_live":
         return
     os.environ["JAX_PLATFORMS"] = "cpu"
     try:
@@ -58,27 +59,33 @@ def pin_cpu_backend() -> None:
 _MISSING = object()
 
 
-def _backend_already_live() -> bool:
-    """True when this process's JAX backend is already initialized — the one
-    state where pinning/probing must not run (retargeting a live process
-    would silently move its subsequent dispatches).  Prefers the public-ish
-    ``xla_bridge.backends_are_initialized()``; on attribute drift in a future
-    JAX, fails CLOSED (assume live): a missed probe only costs wedge
-    protection, while a false "not live" would re-pin a process that already
-    holds a TPU backend — the exact silent-retarget hazard this guard
-    exists to prevent."""
+def _backend_liveness() -> str:
+    """Whether this process's JAX backend is already initialized: "live",
+    "not_live", or "unknown" (JAX-version attribute drift).  The tri-state
+    matters because the two consumers fail in opposite directions: the
+    killable subprocess probe is safe to run when liveness is unknown (so
+    ensure_responsive_backend skips only on a definite "live" — wedge
+    protection survives drift), while pin_cpu_backend must NOT retarget a
+    possibly-live process (so it acts only on a definite "not_live").
+    Prefers the public-ish ``xla_bridge.backends_are_initialized()``."""
     try:
         from jax._src import xla_bridge as _xb
 
         fn = getattr(_xb, "backends_are_initialized", None)
         if fn is not None:
-            return bool(fn())
+            return "live" if fn() else "not_live"
         backends = getattr(_xb, "_backends", _MISSING)
         if backends is _MISSING:
-            return True  # both signals gone: fail closed
-        return bool(backends)
-    except Exception:  # noqa: BLE001 — JAX-version drift: fail closed
-        return True
+            return "unknown"  # both signals gone
+        return "live" if backends else "not_live"
+    except Exception:  # noqa: BLE001 — JAX-version drift
+        return "unknown"
+
+
+def _backend_already_live() -> bool:
+    """Back-compat boolean view (probe consumer): only a definite "live"
+    counts — "unknown" keeps the killable probe running."""
+    return _backend_liveness() == "live"
 
 
 def _remote_platform_in_play() -> bool:
@@ -96,8 +103,10 @@ def ensure_responsive_backend(timeout_s: float | None = None) -> str:
 
     Returns "skipped" (no remote platform in play, already pinned to cpu,
     probing disabled via ICT_NO_DEVICE_PROBE=1 / ICT_DEVICE_PROBE_S<=0, or
-    a backend is already live), "ok" (probe answered), or "demoted" (probe
-    hung through two windows; process pinned to CPU).
+    a backend is already live), "ok" (probe answered), "demoted" (probe
+    hung through two windows; process pinned to CPU), or "demote_failed"
+    (probe hung but liveness was undeterminable, so the pin was declined —
+    the caller was warned the next JAX call may hang).
     """
     if timeout_s is None:
         timeout_s = float(os.environ.get("ICT_DEVICE_PROBE_S", 120))
@@ -112,6 +121,19 @@ def ensure_responsive_backend(timeout_s: float | None = None) -> str:
         if probe_default_backend(timeout_s) != "hang":
             return "ok"
     pin_cpu_backend()
+    if _backend_liveness() == "unknown":
+        # pin_cpu_backend declined (it must not retarget a possibly-live
+        # backend), so the demotion did NOT take — say so instead of
+        # promising a CPU fallback the next JAX call won't honor.
+        print(
+            f"warning: the default JAX backend hung through two "
+            f"{timeout_s:.0f}s probes (wedged device tunnel?), but backend "
+            "liveness is undeterminable under this JAX version so the CPU "
+            "fallback was NOT applied — the next JAX call may hang; set "
+            "JAX_PLATFORMS=cpu in the environment before launch to force "
+            "the fallback",
+            file=sys.stderr)
+        return "demote_failed"
     print(
         f"warning: the default JAX backend hung through two {timeout_s:.0f}s "
         "probes (wedged device tunnel?); falling back to the CPU backend — "

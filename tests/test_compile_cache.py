@@ -57,10 +57,12 @@ def test_clean_cube_notes_shape_on_jax_path_only(small_archive, monkeypatch):
     # Keys carry a route fingerprint: one cube shape can compile several
     # executable sets (stepwise/fused/x64/residual), and the ~70-compile
     # segfault budget is per executable.
-    assert seen == [(*D.shape, "stepwise", False, False, False)]
+    pr = (0.0, 0.0, 1.0)
+    assert seen == [(*D.shape, "stepwise", False, False, pr)]
     seen.clear()
     clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1, fused=True))
-    assert seen == [(*D.shape, "fused", False, False, False)]
+    # fused_clean additionally specializes on want_residual and max_iter.
+    assert seen == [(*D.shape, "fused", False, False, False, 1, pr)]
 
 
 def test_pallas_residual_fallback_keys_as_stepwise(small_archive, monkeypatch):
@@ -76,7 +78,9 @@ def test_pallas_residual_fallback_keys_as_stepwise(small_archive, monkeypatch):
     D, w0 = preprocess(small_archive)
     clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1, pallas=True),
                want_residual=True)
-    assert seen == [(*D.shape, "stepwise", False, False, True)]
+    # No want_residual axis on the stepwise route: clean_step compiles the
+    # identical executable either way.
+    assert seen == [(*D.shape, "stepwise", False, False, (0.0, 0.0, 1.0))]
 
 
 def test_malformed_scan_cap_env_does_not_crash(small_archive, monkeypatch):
@@ -104,7 +108,7 @@ def test_chunked_route_notes_block_shape(small_archive, monkeypatch):
     nsub, nchan, nbin = D.shape
     block = max(nsub // 2 - 1, 1)  # forces a remainder slab
     clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1, chunk_block=block))
-    fp = ("chunked", False, False, False)
+    fp = ("chunked", False, False, False, (0.0, 0.0, 1.0))
     expect = [(block, nchan, nbin, *fp)]
     if nsub > block and nsub % block:
         expect.append((nsub % block, nchan, nbin, *fp))

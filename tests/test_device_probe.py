@@ -95,3 +95,39 @@ class TestHangPath:
         monkeypatch.setattr(device_probe.subprocess, "run", fake_run)
         assert device_probe.ensure_responsive_backend(timeout_s=0.01) == "ok"
         assert len(calls) == 2
+
+
+class TestLivenessDrift:
+    """JAX-version attribute drift: when both liveness signals are gone the
+    probe must still run (wedge *detection* survives), but the CPU pin must
+    decline (never retarget a possibly-live backend) and the demotion must
+    say so honestly."""
+
+    @pytest.fixture
+    def _drifted(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.delenv("ICT_NO_DEVICE_PROBE", raising=False)
+        monkeypatch.delenv("ICT_DEVICE_PROBE_S", raising=False)
+        import jax._src.xla_bridge as xb
+
+        monkeypatch.delattr(xb, "backends_are_initialized", raising=False)
+        monkeypatch.delattr(xb, "_backends", raising=False)
+
+    def test_liveness_reports_unknown(self, _drifted):
+        assert device_probe._backend_liveness() == "unknown"
+        assert device_probe._backend_already_live() is False  # probe still runs
+
+    def test_hang_with_unknown_liveness_declines_pin(
+        self, _drifted, monkeypatch, capsys
+    ):
+        def fake_run(*a, **kw):
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=kw["timeout"])
+
+        monkeypatch.setattr(device_probe.subprocess, "run", fake_run)
+        out = device_probe.ensure_responsive_backend(timeout_s=0.01)
+        assert out == "demote_failed"
+        import os
+
+        assert os.environ["JAX_PLATFORMS"] == "axon"  # pin declined
+        err = capsys.readouterr().err
+        assert "NOT applied" in err and "may hang" in err

@@ -162,3 +162,128 @@ class TestStepSemantics:
         tb, _ = NumpyCleaner(D, w_b, CleanConfig(backend="numpy")).step(w_b)
         # Uniform weight rescaling cancels in the robust scalers
         np.testing.assert_allclose(ta, tb, rtol=1e-5)
+
+
+class TestLeastsqBadStatusBranch:
+    """The reference zeroes a profile when MINPACK returns a fit status
+    outside (1,2,3,4) (iterative_cleaner.py:283-287).  The closed form maps
+    every degenerate case to amp = 1 instead, so this class provides the
+    directed evidence (VERDICT r03, Missing #1) that the zero-profile branch
+    is DEAD on every input class the framework accepts: real
+    scipy.optimize.leastsq on NaN/inf-poisoned and flat objectives returns
+    status 4 with its initial guess — never a bad status.
+
+    Why parity is structural, not coincidental: the template is the weighted
+    sum over ALL profiles, and a NaN/inf sample anywhere poisons it (even a
+    pre-zapped profile contributes 0*NaN = NaN), so a poisoned profile can
+    never coexist with a finite template.  Every profile's objective then
+    goes flat at once: leastsq returns amp = 1 everywhere, and the closed
+    form's <t,t> is non-finite so it maps amp = 1 everywhere too.
+    """
+
+    @pytest.mark.parametrize("case", [
+        "clean", "prof_nan", "prof_inf", "template_nan", "template_inf",
+        "template_zero", "both_zero", "prof_zero",
+    ])
+    def test_status_stays_in_accepted_set(self, case, rng):
+        import scipy.optimize
+
+        t = rng.normal(size=64).astype(np.float32)
+        p = rng.normal(size=64).astype(np.float32)
+        if case == "prof_nan":
+            p[3] = np.nan
+        elif case == "prof_inf":
+            p[3] = np.inf
+        elif case == "template_nan":
+            t[5] = np.nan
+        elif case == "template_inf":
+            t[5] = np.inf
+        elif case == "template_zero":
+            t = np.zeros_like(t)
+        elif case == "both_zero":
+            t = np.zeros_like(t)
+            p = np.zeros_like(p)
+        elif case == "prof_zero":
+            p = np.zeros_like(p)
+        err = lambda amp: amp * t - p
+        with np.errstate(all="ignore"):
+            params, status = scipy.optimize.leastsq(err, [1.0])
+        assert status in (1, 2, 3, 4)  # the :283-287 branch never triggers
+        if case not in ("clean", "prof_zero", "template_inf"):
+            # Flat/poisoned objective: leastsq returns its initial guess —
+            # the exact behavior the closed form's amp=1 mapping mirrors.
+            assert params[0] == 1.0
+
+    @pytest.mark.parametrize("mutate", [
+        pytest.param(lambda D: None, id="clean"),
+        pytest.param(lambda D: D.__setitem__((2, 3, 5), np.nan),
+                     id="one-nan-sample"),
+        pytest.param(lambda D: D.__setitem__((2, 3), np.nan),
+                     id="all-nan-profile"),
+        pytest.param(lambda D: D.__setitem__((1, 2, 7), np.inf),
+                     id="one-inf-sample"),
+        pytest.param(lambda D: (D.__setitem__((1, 2, 7), np.inf),
+                                D.__setitem__((4, 5, 0), -np.inf)),
+                     id="pm-inf-two-profiles"),
+        pytest.param(lambda D: (D.__setitem__((2, 3), np.nan),
+                                D.__setitem__((1, 2, 7), np.inf)),
+                     id="nan-plus-inf"),
+    ])
+    def test_full_loop_mask_matches_real_leastsq_pipeline(self, mutate, rng):
+        """Reference-faithful per-profile leastsq pipeline (status check,
+        zero-profile branch, f32 write-back) vs the closed form: per-iteration
+        masks must agree on NaN/inf-laden cubes the fuzz corpus draws."""
+        import scipy.optimize
+
+        from iterative_cleaner_tpu.backends.numpy_backend import build_template
+        from iterative_cleaner_tpu.io.synthetic import RFISpec, make_archive
+        from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+        class LeastsqCleaner(NumpyCleaner):
+            """NumpyCleaner with the fit swapped for the reference's exact
+            per-profile remove_profile1d (iterative_cleaner.py:274-287)."""
+
+            statuses: set[int]
+
+            def step(self, w_prev):
+                if not hasattr(self, "statuses"):
+                    self.statuses = set()
+                template = build_template(
+                    self.D, np.asarray(w_prev, np.float32))
+                nsub, nchan, _nbin = self.D.shape
+                resid = np.empty_like(self.D)
+                for s in range(nsub):
+                    for c in range(nchan):
+                        prof = self.D[s, c]
+                        err = lambda amp: amp * template - prof  # noqa: E731
+                        with np.errstate(all="ignore"):
+                            params, status = scipy.optimize.leastsq(
+                                err, [1.0])
+                            err2 = np.asarray(err(params))
+                        self.statuses.add(int(status))
+                        if status not in (1, 2, 3, 4):  # reference :283-287
+                            err2 = np.zeros_like(prof)
+                        resid[s, c] = err2  # f32 cast, like get_amps()[:]=
+                weighted = resid * self.w0[..., None]
+                data_ma = np.ma.masked_array(weighted, mask=self._mask3d)
+                with np.errstate(all="ignore"):
+                    test = comprehensive_stats(data_ma, self.cfg)
+                new_w = self.w0.copy()
+                new_w[test >= 1] = 0.0
+                return test, new_w
+
+        archive = make_archive(nsub=6, nchan=8, nbin=32, seed=3,
+                               rfi=RFISpec(1, 1, 1, 0, 2))
+        D, w0 = preprocess(archive)
+        D = np.array(D)
+        mutate(D)
+        cfg = CleanConfig(backend="numpy", max_iter=4)
+        oracle = NumpyCleaner(D, w0, cfg)
+        faithful = LeastsqCleaner(D, w0, cfg)
+        w_a = w_b = w0
+        for _ in range(4):
+            with np.errstate(all="ignore"):
+                _, w_a = oracle.step(w_a)
+                _, w_b = faithful.step(w_b)
+            np.testing.assert_array_equal(w_a, w_b)
+        assert faithful.statuses <= {1, 2, 3, 4}

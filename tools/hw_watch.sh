@@ -5,10 +5,12 @@
 # (hours); a wedged tunnel hangs the FIRST jax.devices() process-wide, so
 # every probe runs in a killable subprocess (see CLAUDE.md).
 #
-# Usage: bash tools/hw_watch.sh   (from the repo root; logs to docs/hw_watch.log)
+# Usage: bash tools/hw_watch.sh   (from the repo root; logs to
+# /tmp/hw_watch.log — runtime telemetry stays out of the tree; only the
+# produced bench/probe artifacts under docs/ are worth versioning)
 set -u
 cd "$(dirname "$0")/.."
-LOG=docs/hw_watch.log
+LOG=/tmp/hw_watch.log
 probe() {
     timeout 75 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null
 }
