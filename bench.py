@@ -237,8 +237,10 @@ def _bench_config(tag, nsub, nchan, nbin, *, full_numpy, dev):
     log(f"[{tag}] host->device upload: {t_upload:.2f}s "
         f"({upload_gbps * 1e3:.0f} MB/s)")
 
-    # --- JAX: fused loop, cold then warm ---
-    kw = dict(max_iter=MAX_ITER, pulse_region=(0.0, 0.0, 1.0))
+    # --- JAX: fused loop, cold then warm (incremental template = the
+    # default route; the dense A/B quantifies the saved cube pass) ---
+    kw = dict(max_iter=MAX_ITER, pulse_region=(0.0, 0.0, 1.0),
+              incremental=True)
     t0 = time.time()
     fused_out = fused_clean(Dd, w0d, validd, 5.0, 5.0, **kw)
     w_jax = np.asarray(fused_out[1])
@@ -252,6 +254,19 @@ def _bench_config(tag, nsub, nchan, nbin, *, full_numpy, dev):
                jax_step_s=round(t_jax_step, 4), iterations=iters)
     log(f"[{tag}] fused cold: {t_cold:.2f}s; warm: {t_warm:.3f}s "
         f"({iters} iterations, {t_jax_step:.4f}s/iter)")
+    kw_dense = {**kw, "incremental": False}
+    w_dense = np.asarray(fused_clean(Dd, w0d, validd, 5.0, 5.0, **kw_dense)[1])
+    t_warm_dense = _min_time(lambda: np.asarray(
+        fused_clean(Dd, w0d, validd, 5.0, 5.0, **kw_dense)[1]))
+    out.update(
+        jax_warm_loop_dense_template_s=round(t_warm_dense, 4),
+        incremental_template_speedup=round(t_warm_dense / max(t_warm, 1e-9), 3),
+        incremental_template_mask_identical=bool(
+            np.array_equal(w_jax, w_dense)),
+    )
+    log(f"[{tag}] dense-template A/B: {t_warm_dense:.3f}s warm "
+        f"({out['incremental_template_speedup']}x from the incremental "
+        f"update; masks identical={out['incremental_template_mask_identical']})")
 
     # --- parity ---
     step1 = clean_step(Dd, w0d, validd, w0d, 5.0, 5.0,
@@ -437,6 +452,98 @@ def _bench_pallas(state) -> dict:
     log(f"[pallas] compiled: cold {t_cold:.2f}s, warm {t_warm:.3f}s, "
         f"parity_vs_xla={res['parity_vs_xla']}")
     return res
+
+
+def _bench_peak_factor(state, dev) -> dict:
+    """Empirically derive autoshard.PEAK_CUBE_FACTOR when memory_stats()
+    reports nothing (the axon platform): two bisections against real
+    allocator behavior —
+
+    1. the largest single extra allocation with config A's cube resident
+       (≈ free HBM), then
+    2. the largest ballast the warm fused loop still completes alongside
+       (peak_extra ≈ free − ballast*).
+
+    peak_cube_factor_measured = (cube + peak_extra) / cube.  OOM attempts
+    are caught per try; BENCH_PROBE_PEAK=0 skips the section entirely for
+    operators who don't want deliberate OOMs near a flaky tunnel."""
+    import jax.numpy as jnp
+
+    from iterative_cleaner_tpu.backends.jax_backend import fused_clean
+
+    import jax
+
+    D, w0, Dd, w0d, validd, _ = state
+    if Dd is None:
+        # Runs LAST by design (a deliberate-OOM probe must not endanger the
+        # headline sections), after config A's device buffers were dropped
+        # for config B — re-upload from the host copies.
+        Dd = jax.device_put(jnp.asarray(D))
+        w0d = jax.device_put(jnp.asarray(w0))
+        validd = w0d != 0
+        _force(Dd)
+
+    def try_alloc(nbytes):
+        try:
+            b = jnp.zeros((max(int(nbytes) // 4, 1),), jnp.float32)
+            _force(b)
+            return b
+        except Exception:  # noqa: BLE001 — RESOURCE_EXHAUSTED is the signal
+            return None
+
+    # Bisect the largest single extra allocation (resolution: hi/2^steps).
+    lo, hi = 0, 64 << 30
+    for _ in range(10):
+        mid = (lo + hi) // 2
+        buf = try_alloc(mid)
+        if buf is not None:
+            del buf
+            lo = mid
+        else:
+            hi = mid
+    free_max = lo
+    out = {"free_with_cube_resident_gb": round(free_max / 1e9, 2)}
+    log(f"[peak] largest extra allocation with cube resident: "
+        f"{free_max / 1e9:.2f} GB")
+    if free_max < (64 << 20):
+        out["skipped"] = "no measurable free memory headroom"
+        return out
+
+    kw = dict(max_iter=MAX_ITER, pulse_region=(0.0, 0.0, 1.0),
+              incremental=True)  # the already-compiled config-A executable
+
+    def fused_ok() -> bool:
+        try:
+            np.asarray(fused_clean(Dd, w0d, validd, 5.0, 5.0, **kw)[1])
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    lo, hi = 0, free_max
+    for _ in range(6):
+        mid = (lo + hi) // 2
+        ballast = try_alloc(mid)
+        if ballast is None:
+            hi = mid
+            continue
+        ok = fused_ok()
+        del ballast
+        if ok:
+            lo = mid
+        else:
+            hi = mid
+    peak_extra = free_max - lo
+    factor = (D.nbytes + peak_extra) / D.nbytes
+    out.update(
+        ballast_tolerated_gb=round(lo / 1e9, 2),
+        peak_extra_gb=round(peak_extra / 1e9, 2),
+        peak_cube_factor_measured=round(factor, 2),
+        method="ballast bisection (6 steps) around the warm fused loop",
+    )
+    log(f"[peak] fused loop tolerates {lo / 1e9:.2f} GB ballast -> "
+        f"peak_cube_factor_measured={factor:.2f} "
+        f"(autoshard.PEAK_CUBE_FACTOR guess: 3.5)")
+    return out
 
 
 def _host_ram_bytes() -> int:
@@ -643,6 +750,20 @@ def run_bench() -> dict:
     if os.environ.get("BENCH_SKIP_CHUNKED", "0") == "0":
         run_section("chunked", lambda: _bench_chunked(
             state, out_a.get("upload_gbps", 0.0)))
+
+    if (os.environ.get("BENCH_PROBE_PEAK", "1") != "0"
+            and "peak_cube_factor_measured" not in out_a
+            and dev.platform != "cpu"):
+        # memory_stats() gave nothing: derive the autoshard routing constant
+        # by allocation bisection.  Deliberately LAST — the probe courts
+        # OOMs (caught) and, on a flaky tunnel, hangs (not catchable), so it
+        # must never cost the headline sections (the r03 lesson); it
+        # re-uploads config A's cube from the host copy.
+        run_section("peak_factor", lambda: _bench_peak_factor(state, dev))
+        pf = _PAYLOAD.get("peak_factor", {})
+        if isinstance(pf, dict) and "peak_cube_factor_measured" in pf:
+            _PAYLOAD["peak_cube_factor_measured"] = pf[
+                "peak_cube_factor_measured"]
     del state
 
     _PAYLOAD["tunnel_note"] = (

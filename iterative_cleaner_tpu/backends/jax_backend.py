@@ -34,22 +34,11 @@ from iterative_cleaner_tpu.ops.stats import (
 from iterative_cleaner_tpu.ops.template import build_template, fit_and_subtract
 
 
-@partial(jax.jit, static_argnames=("pulse_region", "use_pallas"))
-def clean_step(D, w0, valid, w_prev, chanthresh, subintthresh, *, pulse_region,
-               use_pallas=False):
-    """One cleaning iteration as a pure function (jit-compiled once).
-
-    w_prev shapes the template (previous iteration's zaps); the stats always
-    run against the frozen original weights w0 (§8.L11).  The thresholds are
-    traced scalars — a threshold sweep reuses one compilation; only
-    pulse_region (trace-time slicing) and shapes are static.
-
-    use_pallas routes the fit/subtract/weight/centre/moments through the
-    fused Pallas kernel (one HBM pass over the cube instead of ~5 — see
-    ops/pallas_kernels.py); it does not materialise the residual, so the
-    stepwise --unload_res path keeps the XLA route.
-    """
-    template = build_template(D, w_prev)
+def _step_from_template(D, w0, valid, template, chanthresh, subintthresh, *,
+                        pulse_region, use_pallas=False):
+    """Fit/subtract/stats/zap given an already-built template — shared by
+    clean_step (which builds it densely every call) and the incremental
+    fused loop (which carries it across iterations)."""
     if use_pallas:
         from iterative_cleaner_tpu.ops.pallas_kernels import (
             fused_fit_moments,
@@ -82,19 +71,98 @@ def clean_step(D, w0, valid, w_prev, chanthresh, subintthresh, *, pulse_region,
     return test, new_w, resid
 
 
+@partial(jax.jit, static_argnames=("pulse_region", "use_pallas"))
+def clean_step(D, w0, valid, w_prev, chanthresh, subintthresh, *, pulse_region,
+               use_pallas=False):
+    """One cleaning iteration as a pure function (jit-compiled once).
+
+    w_prev shapes the template (previous iteration's zaps); the stats always
+    run against the frozen original weights w0 (§8.L11).  The thresholds are
+    traced scalars — a threshold sweep reuses one compilation; only
+    pulse_region (trace-time slicing) and shapes are static.
+
+    use_pallas routes the fit/subtract/weight/centre/moments through the
+    fused Pallas kernel (one HBM pass over the cube instead of ~5 — see
+    ops/pallas_kernels.py); it does not materialise the residual, so the
+    stepwise --unload_res path keeps the XLA route.
+    """
+    template = build_template(D, w_prev)
+    return _step_from_template(
+        D, w0, valid, template, chanthresh, subintthresh,
+        pulse_region=pulse_region, use_pallas=use_pallas)
+
+
+# Per-iteration budget of profile flips the incremental template update
+# handles sparsely; beyond it the template is rebuilt densely.  Iteration 1
+# typically zaps the bulk (dense rebuild), later iterations flip a handful
+# (sparse).  512 profiles x nbin is a ~2 MB gather at north-star scale —
+# noise next to the cube passes it replaces.
+INCREMENTAL_TEMPLATE_BUDGET = 512
+
+
+def _incremental_template(D, T_prev, w_prev, new_w):
+    """Next iteration's template without re-reading the cube.
+
+    The dense template is ``sum_sc w[s,c] * D[s,c,:]``; between iterations
+    only the profiles whose weight flipped contribute a change, so
+    ``T_next = T_prev + sum_changed (new_w - w_prev) * profile`` — a
+    static-size gather of at most INCREMENTAL_TEMPLATE_BUDGET profiles
+    (jnp.nonzero with a static ``size``).  Falls back to a dense rebuild
+    (lax.cond: the unused branch does not execute outside vmap) when:
+
+    - more profiles flipped than the budget (typically iteration 1), or
+    - the sparse candidate is non-finite — an inf/NaN profile entering or
+      leaving the template support makes inf-inf = NaN where the dense
+      rebuild is finite, so any poisoned cube stays on the per-iteration
+      dense path and keeps today's bit-exact behavior (SURVEY §8.L9's
+      exclusions are unaffected).
+
+    Float caveat (documented in docs/SCALING.md): on the sparse path the
+    template's f32 rounding differs from a dense rebuild (add/remove vs
+    one fused reduction).  Flag-mask invariance to template summation
+    order is the empirically-pinned property that already covers the three
+    dense lowerings; the fuzz corpus revalidates it for this path.
+    """
+    nbin = D.shape[-1]
+    budget = min(INCREMENTAL_TEMPLATE_BUDGET, w_prev.size)
+    delta = (new_w - w_prev).reshape(-1)
+    nchanged = jnp.sum(delta != 0)
+    idx = jnp.nonzero(delta != 0, size=budget, fill_value=0)[0]
+    # Padded slots repeat index 0; zero their contribution explicitly.
+    slot_live = jnp.arange(budget) < nchanged
+    dvals = jnp.where(slot_live, delta[idx], jnp.zeros((), delta.dtype))
+    profiles = D.reshape(-1, nbin)[idx]
+    T_sparse = T_prev + jnp.matmul(
+        dvals, profiles, precision=jax.lax.Precision.HIGHEST)
+    sparse_ok = (nchanged <= budget) & jnp.all(jnp.isfinite(T_sparse))
+    return jax.lax.cond(
+        sparse_ok,
+        lambda: T_sparse,
+        lambda: build_template(D, new_w),
+    )
+
+
 @partial(jax.jit, static_argnames=(
-    "max_iter", "pulse_region", "want_residual", "use_pallas"))
+    "max_iter", "pulse_region", "want_residual", "use_pallas", "incremental"))
 def fused_clean(
     D, w0, valid, chanthresh, subintthresh, *, max_iter, pulse_region,
-    want_residual=False, use_pallas=False,
+    want_residual=False, use_pallas=False, incremental=False,
 ):
     """The whole convergence loop on device (lax.while_loop).
 
-    Carry: (x, w_prev, history, test[, resid], loops, done).  history[0] is
-    the pre-loop weights — included in the cycle detection exactly as the
-    reference seeds test_weights with them (iterative_cleaner.py:77-78).  The
-    D-sized residual buffer is only carried when want_residual is set, so the
-    benchmark configuration does not pay a second cube of HBM.
+    Carry: (x, w_prev, template, history, test[, resid], loops, done).
+    history[0] is the pre-loop weights — included in the cycle detection
+    exactly as the reference seeds test_weights with them
+    (iterative_cleaner.py:77-78).  The D-sized residual buffer is only
+    carried when want_residual is set, so the benchmark configuration does
+    not pay a second cube of HBM.
+
+    ``incremental`` (static) carries the template across iterations and
+    updates it from the handful of flipped profiles instead of re-reading
+    the whole cube each iteration (_incremental_template) — one full cube
+    pass per iteration eliminated after the first.  Keep it False under
+    vmap (sweep/batch): vmapped lax.cond becomes a select that executes
+    BOTH branches, paying the dense rebuild plus the gather.
     """
     if want_residual and use_pallas:
         raise ValueError("the Pallas-fused path does not materialise the "
@@ -102,34 +170,49 @@ def fused_clean(
                          "want_residual=False")
     nsub, nchan = w0.shape
     history0 = jnp.zeros((max_iter + 1, nsub, nchan), w0.dtype).at[0].set(w0)
+    n_extra = 1 if incremental else 0  # template slot in the carry
 
     def cond(carry):
         return (~carry[-1]) & (carry[0] < max_iter)
 
     def body(carry):
-        x, w_prev, history = carry[0] + 1, carry[1], carry[2]
-        test, new_w, resid = clean_step(
-            D, w0, valid, w_prev, chanthresh, subintthresh,
+        x, w_prev = carry[0] + 1, carry[1]
+        if incremental:
+            template = carry[2]
+        else:
+            template = build_template(D, w_prev)
+        history = carry[2 + n_extra]
+        test, new_w, resid = _step_from_template(
+            D, w0, valid, template, chanthresh, subintthresh,
             pulse_region=pulse_region, use_pallas=use_pallas,
         )
         row_live = jnp.arange(max_iter + 1) < x  # rows 0..x-1 are populated
         hit = jnp.any(row_live & jnp.all(new_w[None] == history, axis=(1, 2)))
         history = history.at[x].set(new_w)
         loops = jnp.where(hit, x, max_iter)
+        out = (x, new_w)
+        if incremental:
+            out += (_incremental_template(D, template, w_prev, new_w),)
+        out += (history, test)
         if want_residual:
-            return x, new_w, history, test, resid, loops, hit
-        return x, new_w, history, test, loops, hit
+            out += (resid,)
+        return out + (loops, hit)
 
-    test0 = jnp.zeros_like(w0)
-    init = (0, w0, history0, test0, max_iter, False)
+    init = (0, w0)
+    if incremental:
+        # Iteration 1's template is the dense build from the pre-loop
+        # weights on both routes (bitwise identical); only iterations >= 2
+        # diverge onto the sparse-update path.
+        init += (build_template(D, w0),)
+    init += (history0, jnp.zeros_like(w0))
     if want_residual:
-        init = (0, w0, history0, test0, jnp.zeros_like(D), max_iter, False)
+        init += (jnp.zeros_like(D),)
+    init += (max_iter, False)
     out = jax.lax.while_loop(cond, body, init)
-    if want_residual:
-        x, w_final, history, test, resid, loops, done = out
-    else:
-        x, w_final, history, test, loops, done = out
-        resid = None
+    x, w_final = out[0], out[1]
+    history, test = out[2 + n_extra], out[3 + n_extra]
+    resid = out[4 + n_extra] if want_residual else None
+    loops, done = out[-2], out[-1]
     return test, w_final, loops, done, x, resid, history
 
 
@@ -198,6 +281,7 @@ def run_fused(D, w0, cfg: CleanConfig, want_residual: bool = False):
         pulse_region=tuple(cfg.pulse_region),
         want_residual=want_residual,
         use_pallas=cfg.pallas and not want_residual,
+        incremental=cfg.incremental_template,
     )
     n_iters = int(x)
     out = (

@@ -97,6 +97,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "N-subint blocks, regardless of the device-memory "
                         "estimate (0 = automatic; the escape hatch when the "
                         "working-set estimate or reported HBM is off)")
+    p.add_argument("--no_incremental_template", action="store_true",
+                   help="jax --fused: rebuild the template densely every "
+                        "iteration instead of carrying it across iterations "
+                        "and updating it from the flipped profiles (the "
+                        "incremental update saves one full cube read per "
+                        "iteration after the first; masks are pinned "
+                        "identical across both routes by the fuzz corpus)")
     p.add_argument("--dump_masks", action="store_true",
                    help="save the final mask (plus per-iteration history in "
                         "stepwise mode) as <output>_masks.npz")
@@ -138,6 +145,7 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         x64=args.x64,
         sharded_batch=args.sharded_batch,
         auto_shard=not args.no_auto_shard,
+        incremental_template=not args.no_incremental_template,
         chunk_block=args.chunk_block,
         stream=args.stream,
         resume=args.resume,

@@ -83,6 +83,9 @@ class CleanConfig:
     auto_shard: bool = True        # shard one cube over devices when it exceeds HBM
     chunk_block: int = 0           # force the single-device streaming backend
                                    # with this subint block size (0 = automatic)
+    incremental_template: bool = True  # fused: carry the template across
+                                   # iterations, updating it from flipped
+                                   # profiles (saves a cube pass/iteration)
     stream: bool = False           # sharded_batch: dispatch buckets as loads complete
     resume: bool = False           # skip archives whose cleaned output exists
     dump_masks: bool = False       # save mask history NPZ next to the output
@@ -167,6 +170,7 @@ class CleanConfig:
             ("x64", self.x64),
             ("sharded_batch", self.sharded_batch),
             ("chunk_block", self.chunk_block),
+            ("incremental_template", self.incremental_template),
         ]
         inner = ", ".join(f"{k}={v!r}" for k, v in fields)
         return f"Namespace({inner})"

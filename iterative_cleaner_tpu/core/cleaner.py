@@ -222,7 +222,8 @@ def clean_cube(
             # The step loop always compiles the want_resid=False variant;
             # a residual request additionally compiles the want_resid=True
             # XLA variant in the lazy fetch (chunked.py) — count both.
-            fps = [("chunked", use_pallas, cfg.x64, False, pr)]
+            fps = [("chunked", use_pallas, cfg.x64, False,
+                    cfg.incremental_template, pr)]
             if want_residual:
                 fps.append(("chunked", False, cfg.x64, True, pr))
             slabs = [(min(chunk_block, nsub), nchan, nbin)]
@@ -233,10 +234,10 @@ def clean_cube(
                     note_compiled_shape((*slab, *fp))
         elif cfg.fused:
             # fused_clean statics: max_iter, pulse_region, want_residual,
-            # use_pallas.
+            # use_pallas, incremental.
             note_compiled_shape(
                 (nsub, nchan, nbin, "fused", cfg.pallas, cfg.x64,
-                 want_residual, cfg.max_iter, pr))
+                 want_residual, cfg.max_iter, cfg.incremental_template, pr))
         else:
             # clean_step statics are only (pulse_region, use_pallas): the
             # same executable serves residual and non-residual requests.

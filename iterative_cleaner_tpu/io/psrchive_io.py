@@ -70,6 +70,18 @@ class PsrchiveIO:
         # amplitudes back through the PSRCHIVE object model, mirroring the
         # reference's set_weights_archive + unload flow
         # (iterative_cleaner.py:299-304, 59).
+        #
+        # The classic SWIG bindings expose bulk READS (get_data,
+        # get_weights) but no bulk setters — the only write paths are
+        # per-profile get_amps() view assignment (what the reference itself
+        # does, iterative_cleaner.py:271) and per-cell
+        # Integration.set_weight.  So instead of nsub*nchan*npol
+        # unconditional round-trips (4.2 M at north-star scale — the exact
+        # interpreter-call pathology this project removes), diff against
+        # the freshly-loaded source and touch only cells that changed:
+        # the common weights-only output costs one bulk read + ~zapped-count
+        # set_weight calls, and the residual path (every profile rewritten)
+        # is the only case that pays the full per-profile write.
         ar = _psr.Archive_load(archive.filename)
         nsub, npol, nchan, _ = archive.data.shape
         if ar.get_npol() != npol:
@@ -78,11 +90,24 @@ class PsrchiveIO:
                     f"cannot write {npol}-pol data into a "
                     f"{ar.get_npol()}-pol source archive")
             ar.pscrunch()
-        for isub in range(nsub):
-            integ = ar.get_Integration(isub)
-            for ichan in range(nchan):
-                integ.set_weight(ichan, float(archive.weights[isub, ichan]))
-                for ipol in range(npol):
-                    prof = ar.get_Profile(isub, ipol, ichan)
-                    prof.get_amps()[:] = archive.data[isub, ipol, ichan]
+
+        src_w = np.asarray(ar.get_weights(), dtype=np.float32)
+        new_w = np.asarray(archive.weights, dtype=np.float32)
+        integ = None
+        last_isub = -1
+        for isub, ichan in np.argwhere(src_w != new_w):
+            if isub != last_isub:  # argwhere is row-major: one fetch per subint
+                integ = ar.get_Integration(int(isub))
+                last_isub = isub
+            integ.set_weight(int(ichan), float(new_w[isub, ichan]))
+
+        src_data = np.asarray(ar.get_data(), dtype=np.float32)
+        new_data = np.asarray(archive.data, dtype=np.float32)
+        # One comparison pass decides both "anything to do?" and "which
+        # profiles" (NaN compares unequal to itself, so NaN-bearing profiles
+        # are conservatively rewritten — harmless).
+        changed = np.any(src_data != new_data, axis=3)
+        for isub, ipol, ichan in np.argwhere(changed):
+            prof = ar.get_Profile(int(isub), int(ipol), int(ichan))
+            prof.get_amps()[:] = new_data[isub, ipol, ichan]
         ar.unload(path)

@@ -68,26 +68,45 @@ def sweep_thresholds(
     from iterative_cleaner_tpu.backends.jax_backend import _x64_dtype
 
     dtype = _x64_dtype(cfg)  # a sweep must predict the solo runs it guides
-    D = jnp.asarray(D, dtype)
-    w0 = jnp.asarray(w0, dtype)
-    valid = w0 != 0
 
     # vmap batches the kernel's cube-sized intermediates over the pairs, so
     # peak HBM is ~n_pairs x a solo run's working set; chunk the grid to
     # what the device can hold (each chunk size is one compilation; at most
-    # two distinct sizes occur).
+    # two distinct sizes occur).  All sizing runs on host SHAPES before any
+    # device_put: a cube too big for even one pair must never be uploaded —
+    # it reroutes through per-pair solo cleans (below) instead of OOMing.
     from iterative_cleaner_tpu.parallel.autoshard import (
         HBM_USABLE_FRACTION,
         device_memory_bytes,
         working_set_bytes,
     )
 
+    shape = tuple(np.shape(D))
     chunk = len(pairs)
     hbm = device_memory_bytes()
     if hbm is not None:
-        per_pair = working_set_bytes(D.shape, int(jnp.dtype(dtype).itemsize))
-        chunk = max(1, min(chunk, int(hbm * HBM_USABLE_FRACTION // per_pair)))
-        key = (tuple(D.shape), str(dtype), chunk, len(pairs))
+        per_pair = working_set_bytes(shape, int(jnp.dtype(dtype).itemsize))
+        budget = int(hbm * HBM_USABLE_FRACTION)
+        if per_pair > budget:
+            # Even a single pair exceeds device memory: the batched kernel
+            # cannot run at all.  Each pair is exactly a solo clean with
+            # those thresholds (pinned by tests/test_sweep.py), so run the
+            # grid through clean_cube, whose autoshard/chunked chain
+            # handles >HBM cubes — slower (one streamed clean per pair)
+            # but correct, instead of an opaque device OOM.
+            key = (shape, str(dtype), "solo", len(pairs))
+            if key not in _announced_chunkings:
+                _announced_chunkings.add(key)
+                import sys
+
+                print(
+                    f"sweep: cube {shape} exceeds device memory even for a "
+                    f"single pair; running {len(pairs)} pairs as solo "
+                    "cleans through the >HBM sharded/chunked chain",
+                    file=sys.stderr)
+            return _sweep_via_solo_cleans(D, w0, cfg, pairs, keep_masks)
+        chunk = max(1, min(chunk, budget // per_pair))
+        key = (shape, str(dtype), chunk, len(pairs))
         if chunk < len(pairs) and key not in _announced_chunkings:
             # Announce once per distinct decision — a 1000-archive batch
             # sweep must not print 1000 identical lines.
@@ -97,6 +116,10 @@ def sweep_thresholds(
             print(
                 f"sweep: running {len(pairs)} pairs in chunks of {chunk} "
                 "(full grid would exceed device memory)", file=sys.stderr)
+
+    D = jnp.asarray(D, dtype)
+    w0 = jnp.asarray(w0, dtype)
+    valid = w0 != 0
 
     points: list[SweepPoint] = []
     for start in range(0, len(pairs), chunk):
@@ -122,6 +145,36 @@ def sweep_thresholds(
             )
             for k, (c, s) in enumerate(part)
         )
+    return points
+
+
+def _sweep_via_solo_cleans(
+    D: np.ndarray,
+    w0: np.ndarray,
+    cfg: CleanConfig,
+    pairs: list[tuple[float, float]],
+    keep_masks: bool,
+) -> list[SweepPoint]:
+    """>HBM fallback: one solo clean per pair via clean_cube, which routes
+    oversized cubes through the sharded/chunked chain.  Semantically
+    identical to the batched kernel (a sweep pair IS a solo run with those
+    thresholds); only the dispatch shape differs."""
+    from iterative_cleaner_tpu.core.cleaner import clean_cube
+
+    points: list[SweepPoint] = []
+    for c, s in pairs:
+        res = clean_cube(
+            D, w0,
+            cfg.replace(chanthresh=float(c), subintthresh=float(s)))
+        points.append(
+            SweepPoint(
+                chanthresh=float(c),
+                subintthresh=float(s),
+                rfi_frac=float((res.weights == 0).mean()),
+                loops=res.loops,
+                converged=res.converged,
+                weights=res.weights if keep_masks else None,
+            ))
     return points
 
 

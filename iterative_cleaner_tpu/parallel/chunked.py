@@ -34,13 +34,20 @@ in-memory path so the semantics cannot drift:
 The cross-profile couplings (per-channel / per-subint robust scalers) run
 once on the assembled maps — three orders of magnitude smaller than the cube.
 
-Cost model: 2 cube uploads per iteration (the template needs the previous
-iteration's weights before the fit can run, and no moment trick recovers
-ptp / max|rfft| without re-reading the data).  On a real TPU host the PCIe
-link runs at GB/s, so a 17 GB cube costs ~tens of seconds per iteration —
-against the reference's 4.2 M Python→MINPACK round-trips at the same scale.
-Unlike the sharded reroute this is a stepwise backend, so per-loop progress,
-mask history, and the residual archive all keep working.
+Cost model: 2 cube uploads for the FIRST iteration; from iteration 2 the
+template pass drops out whenever few enough profiles flipped
+(``cfg.incremental_template``, on by default): the backend carries the
+previous template and adds ``sum (Δw) * profile`` over the flipped profiles
+— a host gather of at most ``INCREMENTAL_TEMPLATE_BUDGET`` profiles instead
+of re-streaming the cube — so steady-state cost is ~1 cube upload per
+iteration (the stats pass re-reads the data for fit/ptp/|rfft|; no moment
+trick avoids that).  Any non-finite candidate or over-budget flip count
+falls back to the dense streamed template pass (same soundness rule as the
+fused kernel's `_incremental_template`).  On a real TPU host the PCIe link
+runs at GB/s, so a 17 GB cube costs ~tens of seconds per upload — against
+the reference's 4.2 M Python→MINPACK round-trips at the same scale.  Unlike
+the sharded reroute this is a stepwise backend, so per-loop progress, mask
+history, and the residual archive all keep working.
 """
 
 from __future__ import annotations
@@ -54,6 +61,16 @@ import numpy as np
 from iterative_cleaner_tpu.config import CleanConfig
 from iterative_cleaner_tpu.ops.stats import diagnostics, scale_and_combine
 from iterative_cleaner_tpu.ops.template import build_template, fit_and_subtract
+
+
+@jax.jit
+def _sparse_template_update(tmpl, dvals, profs):
+    """tmpl + sum_k dvals[k] * profs[k] — the flipped-profile correction.
+    Inputs are padded host-side to the fixed INCREMENTAL_TEMPLATE_BUDGET
+    rows (zero rows contribute nothing) so one executable serves every
+    iteration."""
+    return tmpl + jnp.matmul(
+        dvals, profs, precision=jax.lax.Precision.HIGHEST)
 
 
 @jax.jit
@@ -137,6 +154,8 @@ class ChunkedJaxCleaner:
         self._keep_residual = keep_residual
         self._resid_w_prev: np.ndarray | None = None  # last step's weights
         self._residual: np.ndarray | None = None      # lazily-filled cache
+        self._tmpl: jnp.ndarray | None = None     # carried template …
+        self._tmpl_w: np.ndarray | None = None    # … and its weights
         self._use_pallas = False
         if cfg.pallas:
             from iterative_cleaner_tpu.ops.pallas_kernels import (
@@ -187,6 +206,49 @@ class ChunkedJaxCleaner:
         self._sync(template)
         return template
 
+    def _template_for(self, w_host: np.ndarray) -> jnp.ndarray:
+        """Template for these weights, incrementally when possible.
+
+        From iteration 2, ``template = carried + sum (Δw)·profile`` over the
+        flipped profiles — a host gather of ≤ budget rows replacing the full
+        streamed template pass (the module docstring's cost model).  Dense
+        fallback whenever: no carried template yet, over-budget flip count,
+        a non-finite gathered profile, or a non-finite candidate (an inf/NaN
+        profile entering or leaving the support makes inf−inf = NaN where a
+        dense rebuild is finite — the same soundness rule as the fused
+        kernel's ``_incremental_template``)."""
+        from iterative_cleaner_tpu.backends.jax_backend import (
+            INCREMENTAL_TEMPLATE_BUDGET,
+        )
+
+        host_dt = np.float64 if self.cfg.x64 else np.float32
+        tmpl = None
+        if self.cfg.incremental_template and self._tmpl_w is not None:
+            delta = w_host.astype(host_dt) - self._tmpl_w.astype(host_dt)
+            flat = delta.reshape(-1)
+            idx = np.nonzero(flat)[0]
+            budget = min(INCREMENTAL_TEMPLATE_BUDGET, flat.size)
+            if idx.size == 0:
+                tmpl = self._tmpl
+            elif idx.size <= budget:
+                s, c = np.unravel_index(idx, delta.shape)
+                profs = self._D[s, c, :].astype(host_dt)
+                if np.isfinite(profs).all():
+                    pad = budget - idx.size
+                    dvals = np.pad(flat[idx], (0, pad))
+                    profs = np.pad(profs, ((0, pad), (0, 0)))
+                    cand = _sparse_template_update(
+                        self._tmpl,
+                        jnp.asarray(dvals, self._dtype),
+                        jnp.asarray(profs, self._dtype))
+                    if bool(np.isfinite(np.asarray(cand)).all()):
+                        tmpl = cand
+        if tmpl is None:
+            tmpl = self._template(jnp.asarray(w_host, self._dtype))
+        self._tmpl = tmpl
+        self._tmpl_w = w_host.copy()
+        return tmpl
+
     def step(self, w_prev: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         if self._keep_residual:
             # residual() recomputes from these weights on demand — a cube
@@ -194,8 +256,8 @@ class ChunkedJaxCleaner:
             # uses would be pure waste.
             self._resid_w_prev = np.asarray(w_prev)
             self._residual = None
-        w_prev = jnp.asarray(w_prev, self._dtype)
-        template = self._template(w_prev)
+        w_host = np.asarray(w_prev)
+        template = self._template_for(w_host)
 
         # Pass 2: per-block fit + diagnostics; maps accumulate on device.
         if self._use_pallas:
@@ -241,8 +303,12 @@ class ChunkedJaxCleaner:
         if not self._keep_residual or self._resid_w_prev is None:
             return None
         if self._residual is None:
-            template = self._template(
-                jnp.asarray(self._resid_w_prev, self._dtype))
+            if self._tmpl is not None and np.array_equal(
+                    self._resid_w_prev, self._tmpl_w):
+                template = self._tmpl  # the carried template is current
+            else:
+                template = self._template(
+                    jnp.asarray(self._resid_w_prev, self._dtype))
             res_dtype = np.float64 if self.cfg.x64 else np.float32
             self._residual = np.empty(self._D.shape, res_dtype)
             for lo, hi in self._blocks():

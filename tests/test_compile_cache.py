@@ -61,8 +61,9 @@ def test_clean_cube_notes_shape_on_jax_path_only(small_archive, monkeypatch):
     assert seen == [(*D.shape, "stepwise", False, False, pr)]
     seen.clear()
     clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1, fused=True))
-    # fused_clean additionally specializes on want_residual and max_iter.
-    assert seen == [(*D.shape, "fused", False, False, False, 1, pr)]
+    # fused_clean additionally specializes on want_residual, max_iter and
+    # the incremental-template route.
+    assert seen == [(*D.shape, "fused", False, False, False, 1, True, pr)]
 
 
 def test_pallas_residual_fallback_keys_as_stepwise(small_archive, monkeypatch):
@@ -108,7 +109,7 @@ def test_chunked_route_notes_block_shape(small_archive, monkeypatch):
     nsub, nchan, nbin = D.shape
     block = max(nsub // 2 - 1, 1)  # forces a remainder slab
     clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1, chunk_block=block))
-    fp = ("chunked", False, False, False, (0.0, 0.0, 1.0))
+    fp = ("chunked", False, False, False, True, (0.0, 0.0, 1.0))
     expect = [(block, nchan, nbin, *fp)]
     if nsub > block and nsub % block:
         expect.append((nsub % block, nchan, nbin, *fp))

