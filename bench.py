@@ -258,15 +258,25 @@ def _bench_config(tag, nsub, nchan, nbin, *, full_numpy, dev):
     w_dense = np.asarray(fused_clean(Dd, w0d, validd, 5.0, 5.0, **kw_dense)[1])
     t_warm_dense = _min_time(lambda: np.asarray(
         fused_clean(Dd, w0d, validd, 5.0, 5.0, **kw_dense)[1]))
+    inc_mask_ok = bool(np.array_equal(w_jax, w_dense))
     out.update(
         jax_warm_loop_dense_template_s=round(t_warm_dense, 4),
         incremental_template_speedup=round(t_warm_dense / max(t_warm, 1e-9), 3),
-        incremental_template_mask_identical=bool(
-            np.array_equal(w_jax, w_dense)),
+        incremental_template_mask_identical=inc_mask_ok,
     )
+    _PAYLOAD["parity_incremental_vs_dense"] = (
+        _PAYLOAD.get("parity_incremental_vs_dense", True) and inc_mask_ok)
+    if not inc_mask_ok:
+        # Loud, top-level, but non-fatal: the artifact (with the failure
+        # flagged) is worth more than an aborted run — the repo invariant
+        # says masks must be bit-identical, so a False here on real
+        # hardware is the headline finding of the run.
+        log(f"[{tag}] *** INCREMENTAL-TEMPLATE MASK MISMATCH vs dense "
+            "rebuild — investigate before trusting the incremental "
+            "default on this platform ***")
     log(f"[{tag}] dense-template A/B: {t_warm_dense:.3f}s warm "
         f"({out['incremental_template_speedup']}x from the incremental "
-        f"update; masks identical={out['incremental_template_mask_identical']})")
+        f"update; masks identical={inc_mask_ok})")
 
     # --- parity ---
     step1 = clean_step(Dd, w0d, validd, w0d, 5.0, 5.0,
@@ -483,13 +493,22 @@ def _bench_peak_factor(state, dev) -> dict:
         validd = w0d != 0
         _force(Dd)
 
+    def _is_oom(exc: Exception) -> bool:
+        s = str(exc).upper()
+        return ("RESOURCE_EXHAUSTED" in s or "OUT OF MEMORY" in s
+                or "OOM" in s)
+
     def try_alloc(nbytes):
         try:
             b = jnp.zeros((max(int(nbytes) // 4, 1),), jnp.float32)
             _force(b)
             return b
-        except Exception:  # noqa: BLE001 — RESOURCE_EXHAUSTED is the signal
-            return None
+        except Exception as exc:  # noqa: BLE001
+            if _is_oom(exc):
+                return None
+            raise  # transient tunnel/RPC errors must not read as OOM:
+            # a mis-read bisection would fabricate peak_cube_factor_measured
+            # (run_section records the section error instead)
 
     # Bisect the largest single extra allocation (resolution: hi/2^steps).
     lo, hi = 0, 64 << 30
@@ -516,8 +535,10 @@ def _bench_peak_factor(state, dev) -> dict:
         try:
             np.asarray(fused_clean(Dd, w0d, validd, 5.0, 5.0, **kw)[1])
             return True
-        except Exception:  # noqa: BLE001
-            return False
+        except Exception as exc:  # noqa: BLE001
+            if _is_oom(exc):
+                return False
+            raise  # same rule as try_alloc: only a real OOM is a data point
 
     lo, hi = 0, free_max
     for _ in range(6):

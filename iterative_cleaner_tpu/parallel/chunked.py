@@ -156,6 +156,8 @@ class ChunkedJaxCleaner:
         self._residual: np.ndarray | None = None      # lazily-filled cache
         self._tmpl: jnp.ndarray | None = None     # carried template …
         self._tmpl_w: np.ndarray | None = None    # … and its weights
+        self._tmpl_dense = False                  # built by the streamed
+                                                  # pass (not sparse-updated)
         self._use_pallas = False
         if cfg.pallas:
             from iterative_cleaner_tpu.ops.pallas_kernels import (
@@ -223,6 +225,7 @@ class ChunkedJaxCleaner:
 
         host_dt = np.float64 if self.cfg.x64 else np.float32
         tmpl = None
+        dense = False  # provenance of the value we end up carrying
         if self.cfg.incremental_template and self._tmpl_w is not None:
             delta = w_host.astype(host_dt) - self._tmpl_w.astype(host_dt)
             flat = delta.reshape(-1)
@@ -230,6 +233,7 @@ class ChunkedJaxCleaner:
             budget = min(INCREMENTAL_TEMPLATE_BUDGET, flat.size)
             if idx.size == 0:
                 tmpl = self._tmpl
+                dense = self._tmpl_dense  # unchanged carry keeps provenance
             elif idx.size <= budget:
                 s, c = np.unravel_index(idx, delta.shape)
                 profs = self._D[s, c, :].astype(host_dt)
@@ -245,8 +249,13 @@ class ChunkedJaxCleaner:
                         tmpl = cand
         if tmpl is None:
             tmpl = self._template(jnp.asarray(w_host, self._dtype))
+            dense = True
         self._tmpl = tmpl
         self._tmpl_w = w_host.copy()
+        # residual() needs the provenance: its bit-exactness claim vs the
+        # in-memory path holds only for dense-built templates, so a
+        # sparse-updated carry must not be reused there.
+        self._tmpl_dense = dense
         return tmpl
 
     def step(self, w_prev: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
@@ -303,10 +312,14 @@ class ChunkedJaxCleaner:
         if not self._keep_residual or self._resid_w_prev is None:
             return None
         if self._residual is None:
-            if self._tmpl is not None and np.array_equal(
-                    self._resid_w_prev, self._tmpl_w):
-                template = self._tmpl  # the carried template is current
+            if (self._tmpl is not None and self._tmpl_dense
+                    and np.array_equal(self._resid_w_prev, self._tmpl_w)):
+                template = self._tmpl  # current AND dense-built: reusable
             else:
+                # Dense rebuild even when a sparse-updated carry matches
+                # these weights: the residual archive stays bit-exact vs
+                # the in-memory path (the sparse template's ulp drift is
+                # documented for SCORES only, not output data).
                 template = self._template(
                     jnp.asarray(self._resid_w_prev, self._dtype))
             res_dtype = np.float64 if self.cfg.x64 else np.float32

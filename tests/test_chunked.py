@@ -88,6 +88,26 @@ def test_chunked_residual_matches_in_memory():
     np.testing.assert_array_equal(full.residual(), mono.residual())
 
 
+def test_chunked_residual_bit_exact_after_incremental_iterations():
+    """Multi-iteration run with the incremental template carried: the
+    residual fetch must dense-rebuild (never reuse a sparse-updated carry)
+    so a full-block residual stays bit-exact vs the in-memory stepwise
+    path — the sparse ulp envelope is documented for scores only, not
+    output data."""
+    from iterative_cleaner_tpu.backends.jax_backend import JaxCleaner
+
+    D, w0 = _cube(seed=83)
+    cfg = CleanConfig(backend="jax", max_iter=4)
+    mono = JaxCleaner(D, w0, cfg)
+    chunked = ChunkedJaxCleaner(D, w0, cfg, block=8, keep_residual=True)
+    w_m = w_c = w0
+    for _ in range(3):
+        _, w_m = mono.step(w_m)
+        _, w_c = chunked.step(w_c)
+        np.testing.assert_array_equal(np.asarray(w_m), np.asarray(w_c))
+    np.testing.assert_array_equal(chunked.residual(), mono.residual())
+
+
 def test_chunk_block_subints_sizing(monkeypatch):
     cfg = CleanConfig(backend="jax")
     # Fits: no chunking.

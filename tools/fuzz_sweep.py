@@ -71,11 +71,20 @@ def main() -> int:
         modes = {}
         for name, cfg in (
             ("stepwise", CleanConfig(backend="jax", x64=x64, **kw)),
+            # fused/chunked run the r04 incremental-template default; the
+            # dense rebuild stays fuzzed via its own mode (it remains
+            # reachable through --no_incremental_template).
             ("fused", CleanConfig(backend="jax", fused=True, x64=x64, **kw)),
+            ("fused_dense",
+             CleanConfig(backend="jax", fused=True, x64=x64,
+                         incremental_template=False, **kw)),
             # chunk_block routes through the canonical stepwise loop with
             # the streaming backend — no hand-rolled convergence here.
             (f"chunked(b={block})",
              CleanConfig(backend="jax", chunk_block=block, x64=x64, **kw)),
+            (f"chunked_dense(b={block})",
+             CleanConfig(backend="jax", chunk_block=block, x64=x64,
+                         incremental_template=False, **kw)),
         ):
             r = clean_cube(D, w0, cfg)
             modes[name] = (r.weights, r.loops, r.converged)
