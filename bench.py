@@ -494,9 +494,16 @@ def _bench_peak_factor(state, dev) -> dict:
         _force(Dd)
 
     def _is_oom(exc: Exception) -> bool:
+        import re
+
         s = str(exc).upper()
-        return ("RESOURCE_EXHAUSTED" in s or "OUT OF MEMORY" in s
-                or "OOM" in s)
+        # Word-boundary OOM (not 'no rOOM'); RESOURCE_EXHAUSTED counts only
+        # from the XLA runtime (gRPC raises it for tunnel quota/message
+        # limits too, which must NOT shrink the bisection).
+        if re.search(r"\bOOM\b", s) or "OUT OF MEMORY" in s:
+            return True
+        return ("RESOURCE_EXHAUSTED" in s
+                and type(exc).__name__ == "XlaRuntimeError")
 
     def try_alloc(nbytes):
         try:

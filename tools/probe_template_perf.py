@@ -130,12 +130,46 @@ def main() -> int:
     for ax2d, ax3d in ((1, 2), (0, 1)):
         one = jax.jit(lambda x, v, a=ax2d: masked_median(x, v, axis=a))
         three = jax.jit(lambda x, v, a=ax3d: masked_median(x, v, axis=a))
-        t_one = _t(lambda: _force(one(d4[0], valid)))
-        t_three = _t(lambda: _force(three(stacked, vv)))
+        # masked_median returns (median, n_valid) — force the median.
+        t_one = _t(lambda: _force(one(d4[0], valid)[0]))
+        t_three = _t(lambda: _force(three(stacked, vv)[0]))
         print(f"masked_median axis={ax2d}: 1x {t_one * 1e3:7.2f} ms   "
               f"3x-stacked {t_three * 1e3:7.2f} ms "
               f"(batched saves {(3 * t_one - t_three) * 1e3:6.2f} ms)",
               file=sys.stderr)
+
+    # Selection primitives on the map shapes: is a half-depth top_k cheaper
+    # than the full sort the masked medians pay today?  (Informational —
+    # adopting top_k would need the count-based masked-middle semantics
+    # rebuilt on it; only worth designing if the gap is large.)
+    for axis, n in ((1, NCHAN), (0, NSUB)):
+        x = d4[0] if axis == 1 else d4[0].T
+        full = jax.jit(lambda x: jnp.sort(x, axis=1))
+        half = jax.jit(lambda x, k=n // 2 + 1: jax.lax.top_k(x, k)[0])
+        t_full = _t(lambda: _force(full(x)))
+        t_half = _t(lambda: _force(half(x)))
+        print(f"sort-vs-topk axis={axis}: full sort {t_full * 1e3:7.2f} ms  "
+              f"top_k(n/2+1) {t_half * 1e3:7.2f} ms", file=sys.stderr)
+
+    # --- incremental template: the r04 default fused route vs dense ---
+    from iterative_cleaner_tpu.backends.jax_backend import fused_clean
+
+    kw = dict(max_iter=5, pulse_region=(0.0, 0.0, 1.0))
+    res_d = None
+    print("--- fused loop: incremental template A/B ---", file=sys.stderr)
+    for name, inc in (("dense_rebuild", False), ("incremental", True)):
+        out = fused_clean(D, w, valid_all, 5.0, 5.0, incremental=inc, **kw)
+        iters = int(out[4])
+        w_fin = np.asarray(out[1])
+        t = _t(lambda inc=inc: _force(fused_clean(
+            D, w, valid_all, 5.0, 5.0, incremental=inc, **kw)[1]))
+        print(f"{name:16s} {t * 1e3:8.2f} ms total, {iters} iters "
+              f"({t / max(iters, 1) * 1e3:7.2f} ms/iter)", file=sys.stderr)
+        if res_d is None:
+            res_d = w_fin
+        else:
+            print(f"masks identical vs dense: "
+                  f"{bool(np.array_equal(res_d, w_fin))}", file=sys.stderr)
     return 0
 
 
