@@ -71,6 +71,11 @@ def _step_from_template(D, w0, valid, template, chanthresh, subintthresh, *,
     return test, new_w, resid
 
 
+step_from_template = partial(
+    jax.jit, static_argnames=("pulse_region", "use_pallas"))(
+        _step_from_template)
+
+
 @partial(jax.jit, static_argnames=("pulse_region", "use_pallas"))
 def clean_step(D, w0, valid, w_prev, chanthresh, subintthresh, *, pulse_region,
                use_pallas=False):
@@ -140,6 +145,10 @@ def _incremental_template(D, T_prev, w_prev, new_w):
         lambda: T_sparse,
         lambda: build_template(D, new_w),
     )
+
+
+dense_template = jax.jit(build_template)
+advance_template = jax.jit(_incremental_template)
 
 
 @partial(jax.jit, static_argnames=(
@@ -232,7 +241,17 @@ def _x64_dtype(cfg: CleanConfig):
 
 
 class JaxCleaner:
-    """Stepwise backend: same protocol as NumpyCleaner, device-resident."""
+    """Stepwise backend: same protocol as NumpyCleaner, device-resident.
+
+    With ``cfg.incremental_template`` (the default) the template is carried
+    across ``step()`` calls and advanced from the flipped profiles
+    (_incremental_template: same budget/non-finite dense fallback as the
+    fused kernel) — the default CLI route sheds its per-iteration full-cube
+    template read just like --fused.  Note the residual this backend
+    returns is then computed from the sparse-advanced template;
+    ``clean_cube`` forces the dense route whenever the caller requests a
+    residual, keeping residual output bit-exact (the ulp envelope is
+    documented for scores only)."""
 
     def __init__(self, D: np.ndarray, w0: np.ndarray, cfg: CleanConfig) -> None:
         self.cfg = cfg
@@ -241,19 +260,39 @@ class JaxCleaner:
         self._w0 = jax.device_put(jnp.asarray(w0, dtype))
         self._valid = jax.device_put(jnp.asarray(w0 != 0))
         self._residual = None
+        self._tmpl = None     # carried template (device) …
+        self._tmpl_w = None   # … and the device weights it was built for
 
     def step(self, w_prev: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         w_prev = jnp.asarray(w_prev, self._w0.dtype)
-        test, new_w, resid = clean_step(
-            self._D,
-            self._w0,
-            self._valid,
-            w_prev,
-            float(self.cfg.chanthresh),
-            float(self.cfg.subintthresh),
-            pulse_region=tuple(self.cfg.pulse_region),
-            use_pallas=self.cfg.pallas,
-        )
+        if not self.cfg.incremental_template:
+            test, new_w, resid = clean_step(
+                self._D,
+                self._w0,
+                self._valid,
+                w_prev,
+                float(self.cfg.chanthresh),
+                float(self.cfg.subintthresh),
+                pulse_region=tuple(self.cfg.pulse_region),
+                use_pallas=self.cfg.pallas,
+            )
+        else:
+            if self._tmpl is None:
+                template = dense_template(self._D, w_prev)
+            else:
+                template = advance_template(
+                    self._D, self._tmpl, self._tmpl_w, w_prev)
+            test, new_w, resid = step_from_template(
+                self._D,
+                self._w0,
+                self._valid,
+                template,
+                float(self.cfg.chanthresh),
+                float(self.cfg.subintthresh),
+                pulse_region=tuple(self.cfg.pulse_region),
+                use_pallas=self.cfg.pallas,
+            )
+            self._tmpl, self._tmpl_w = template, w_prev
         self._residual = resid  # stays on device unless fetched
         return np.asarray(test), np.asarray(new_w)
 
@@ -281,7 +320,9 @@ def run_fused(D, w0, cfg: CleanConfig, want_residual: bool = False):
         pulse_region=tuple(cfg.pulse_region),
         want_residual=want_residual,
         use_pallas=cfg.pallas and not want_residual,
-        incremental=cfg.incremental_template,
+        # A residual must come from a dense template (bit-exact output;
+        # the sparse path's ulp envelope is documented for scores only).
+        incremental=cfg.incremental_template and not want_residual,
     )
     n_iters = int(x)
     out = (

@@ -83,9 +83,11 @@ class CleanConfig:
     auto_shard: bool = True        # shard one cube over devices when it exceeds HBM
     chunk_block: int = 0           # force the single-device streaming backend
                                    # with this subint block size (0 = automatic)
-    incremental_template: bool = True  # fused: carry the template across
-                                   # iterations, updating it from flipped
-                                   # profiles (saves a cube pass/iteration)
+    incremental_template: bool = True  # jax stepwise/fused/chunked: carry
+                                   # the template across iterations,
+                                   # updating it from flipped profiles
+                                   # (saves a cube pass/iteration; residual
+                                   # requests force the dense route)
     stream: bool = False           # sharded_batch: dispatch buckets as loads complete
     resume: bool = False           # skip archives whose cleaned output exists
     dump_masks: bool = False       # save mask history NPZ next to the output

@@ -197,6 +197,11 @@ def clean_cube(
         # key below so the key matches the executable actually compiled;
         # run_fused applies the same fallback internally).
         cfg = cfg.replace(pallas=False)
+    if want_residual and cfg.incremental_template and chunk_block is None:
+        # Residual output must be bit-exact (dense templates): the sparse
+        # path's ulp envelope is documented for scores only.  The chunked
+        # route keeps incremental — its residual() dense-rebuilds anyway.
+        cfg = cfg.replace(incremental_template=False)
 
     if cfg.backend == "jax":
         nsub, nchan, nbin = D.shape
@@ -241,8 +246,11 @@ def clean_cube(
         else:
             # clean_step statics are only (pulse_region, use_pallas): the
             # same executable serves residual and non-residual requests.
+            # The incremental route swaps clean_step for the
+            # dense/advance/step_from_template executable set.
             note_compiled_shape(
-                (nsub, nchan, nbin, "stepwise", cfg.pallas, cfg.x64, pr))
+                (nsub, nchan, nbin, "stepwise", cfg.pallas, cfg.x64,
+                 cfg.incremental_template, pr))
 
     if cfg.fused and chunk_block is None:
         from iterative_cleaner_tpu.backends.jax_backend import run_fused

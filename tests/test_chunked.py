@@ -98,7 +98,11 @@ def test_chunked_residual_bit_exact_after_incremental_iterations():
 
     D, w0 = _cube(seed=83)
     cfg = CleanConfig(backend="jax", max_iter=4)
-    mono = JaxCleaner(D, w0, cfg)
+    # The in-memory residual reference is the DENSE stepwise route — what
+    # clean_cube enforces whenever a caller requests a residual (a
+    # JaxCleaner driven directly with the incremental default returns a
+    # sparse-template residual, documented in its docstring).
+    mono = JaxCleaner(D, w0, cfg.replace(incremental_template=False))
     chunked = ChunkedJaxCleaner(D, w0, cfg, block=8, keep_residual=True)
     w_m = w_c = w0
     for _ in range(3):

@@ -58,7 +58,7 @@ def test_clean_cube_notes_shape_on_jax_path_only(small_archive, monkeypatch):
     # executable sets (stepwise/fused/x64/residual), and the ~70-compile
     # segfault budget is per executable.
     pr = (0.0, 0.0, 1.0)
-    assert seen == [(*D.shape, "stepwise", False, False, pr)]
+    assert seen == [(*D.shape, "stepwise", False, False, True, pr)]
     seen.clear()
     clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1, fused=True))
     # fused_clean additionally specializes on want_residual, max_iter and
@@ -80,8 +80,11 @@ def test_pallas_residual_fallback_keys_as_stepwise(small_archive, monkeypatch):
     clean_cube(D, w0, CleanConfig(backend="jax", max_iter=1, pallas=True),
                want_residual=True)
     # No want_residual axis on the stepwise route: clean_step compiles the
-    # identical executable either way.
-    assert seen == [(*D.shape, "stepwise", False, False, (0.0, 0.0, 1.0))]
+    # identical executable either way.  want_residual also forces the
+    # dense template route (incremental axis False) — residual output must
+    # be bit-exact.
+    assert seen == [
+        (*D.shape, "stepwise", False, False, False, (0.0, 0.0, 1.0))]
 
 
 def test_malformed_scan_cap_env_does_not_crash(small_archive, monkeypatch):

@@ -138,6 +138,42 @@ def test_fused_incremental_template_masks_exact_scores_close():
     np.testing.assert_allclose(a[fin], b[fin], rtol=5e-5)
 
 
+def test_stepwise_incremental_template_masks_exact():
+    """The default CLI route (stepwise jax) also carries the template now:
+    masks, loops, and full history must stay bit-identical to the dense
+    stepwise route and the numpy oracle."""
+    ar = make_archive(nsub=8, nchan=32, nbin=128, seed=11)
+    D, w0 = preprocess(ar)
+    res_np = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=5))
+    res_inc = clean_cube(D, w0, CleanConfig(backend="jax", max_iter=5))
+    res_dense = clean_cube(D, w0, CleanConfig(
+        backend="jax", max_iter=5, incremental_template=False))
+    for other in (res_inc, res_dense):
+        np.testing.assert_array_equal(res_np.weights, other.weights)
+        assert res_np.loops == other.loops
+        np.testing.assert_array_equal(
+            np.stack(res_np.history), np.stack(other.history))
+
+
+def test_residual_request_forces_dense_templates():
+    """want_residual must produce a bit-exact residual: clean_cube forces
+    the dense-template route on the in-memory paths, so the residual
+    equals the dense stepwise route's exactly."""
+    ar = make_archive(nsub=8, nchan=32, nbin=128, seed=12)
+    D, w0 = preprocess(ar)
+    res_dense = clean_cube(
+        D, w0,
+        CleanConfig(backend="jax", max_iter=4, incremental_template=False),
+        want_residual=True)
+    res_default = clean_cube(
+        D, w0, CleanConfig(backend="jax", max_iter=4), want_residual=True)
+    res_fused = clean_cube(
+        D, w0, CleanConfig(backend="jax", max_iter=4, fused=True),
+        want_residual=True)
+    np.testing.assert_array_equal(res_dense.residual, res_default.residual)
+    np.testing.assert_array_equal(res_dense.residual, res_fused.residual)
+
+
 def test_fused_incremental_template_budget_fallback(monkeypatch):
     """When more profiles flip than the sparse budget, the kernel rebuilds
     the template densely (lax.cond) — force budget=1 so every iteration

@@ -70,10 +70,14 @@ def main() -> int:
         x64 = bool(jax.config.jax_enable_x64)
         modes = {}
         for name, cfg in (
+            # stepwise/fused/chunked run the r04 incremental-template
+            # default; each dense rebuild stays fuzzed via its own mode
+            # (dense remains reachable through --no_incremental_template,
+            # and every want_residual request is forced onto it).
             ("stepwise", CleanConfig(backend="jax", x64=x64, **kw)),
-            # fused/chunked run the r04 incremental-template default; the
-            # dense rebuild stays fuzzed via its own mode (it remains
-            # reachable through --no_incremental_template).
+            ("stepwise_dense",
+             CleanConfig(backend="jax", x64=x64,
+                         incremental_template=False, **kw)),
             ("fused", CleanConfig(backend="jax", fused=True, x64=x64, **kw)),
             ("fused_dense",
              CleanConfig(backend="jax", fused=True, x64=x64,
