@@ -647,10 +647,14 @@ def _bench_chunked(state, upload_gbps: float) -> dict:
             "gen_s": round(t_gen, 1),
             "first_step_s": round(t_first, 2),
             "warm_step_s": round(t_step, 2),
+            # 1 with the incremental default: the steady-state template
+            # pass (one of the 2 cube uploads/iteration) is gone.
+            "template_passes_after_2_steps": backend.template_passes,
         }
         log(f"[chunked] >HBM cube {res['shape']} ({res['cube_gb']} GB vs "
             f"{res['device_hbm_gb']} GB HBM): {t_step:.1f}s/iter "
-            f"(block={block})")
+            f"(block={block}, template passes after 2 steps: "
+            f"{backend.template_passes})")
         return res
 
     block = max(1, D.shape[0] // 4)
@@ -679,12 +683,33 @@ def _bench_chunked(state, upload_gbps: float) -> dict:
         "block_subints": block,
         "first_step_s": round(t_first, 2),
         "warm_step_s": round(t_step, 2),
+        "template_passes_after_2_steps": backend.template_passes,
         "parity_iter1_vs_in_memory": bool(np.array_equal(w1, w_step1)),
-        "note": "2 cube uploads/iteration by design; wall clock is "
-                "upload-dominated on this tunnel environment",
+        "note": "steady state is 1 cube upload/iteration with the "
+                "incremental template (2 with the dense A/B); wall clock "
+                "is upload-dominated on this tunnel environment",
     }
     log(f"[chunked] block={block}: first {t_first:.1f}s, warm {t_step:.1f}s/"
-        f"iter, parity={res['parity_iter1_vs_in_memory']}")
+        f"iter, template passes after 2 steps: {backend.template_passes}, "
+        f"parity={res['parity_iter1_vs_in_memory']}")
+    # Dense-template A/B: quantifies the upload the incremental carry
+    # removes (steady state: 1 cube upload/iteration instead of 2).  Runs
+    # AFTER the primary result exists and is isolated: a tunnel wedge in
+    # these extra cube uploads must not discard the measurements above.
+    # No warm-up step — every executable is already jit-cached from the
+    # incremental backend's steps and the dense backend carries no state.
+    try:
+        backend_d = ChunkedJaxCleaner(
+            D, w0, CleanConfig(backend="jax", incremental_template=False),
+            block=block)
+        t0 = time.time()
+        backend_d.step(w1)
+        res["warm_step_dense_template_s"] = round(time.time() - t0, 2)
+        log(f"[chunked] dense-template A/B: "
+            f"{res['warm_step_dense_template_s']}s/iter")
+    except Exception as exc:  # noqa: BLE001 — A/B is optional detail
+        res["dense_ab_error"] = str(exc)
+        log(f"[chunked] dense A/B FAILED: {exc}")
     return res
 
 

@@ -158,6 +158,8 @@ class ChunkedJaxCleaner:
         self._tmpl_w: np.ndarray | None = None    # … and its weights
         self._tmpl_dense = False                  # built by the streamed
                                                   # pass (not sparse-updated)
+        self.template_passes = 0   # observability: full streamed template
+                                   # accumulations (cube uploads) so far
         self._use_pallas = False
         if cfg.pallas:
             from iterative_cleaner_tpu.ops.pallas_kernels import (
@@ -196,6 +198,7 @@ class ChunkedJaxCleaner:
 
     def _template(self, w_prev) -> jnp.ndarray:
         """Pass 1: template accumulation (device-resident accumulator)."""
+        self.template_passes += 1
         template = jnp.zeros(self._D.shape[-1], self._dtype)
         prev = None
         for lo, hi in self._blocks():
