@@ -21,6 +21,13 @@ while true; do
     plat="$(probe)"
     if [ "$plat" = "tpu" ]; then
         note "HEALTHY window open — running playbook"
+        # The bench's numpy baseline runs on this 1-core host: any
+        # concurrent heavy job (fuzz sweeps, test suites) would inflate it
+        # and overstate the speedup.  Kill them; a fuzz batch is rerunnable,
+        # the healthy-window artifact is not.
+        pkill -f fuzz_sweep.py 2>/dev/null && note "killed fuzz for timing fidelity"
+        pkill -f "pytest tests" 2>/dev/null && note "killed pytest for timing fidelity"
+        sleep 2
         note "probe_template_perf start"
         timeout 1200 python tools/probe_template_perf.py \
             > docs/probe_r04_hw.txt 2>&1
