@@ -151,6 +151,110 @@ dense_template = jax.jit(build_template)
 advance_template = jax.jit(_incremental_template)
 
 
+def precompile_for(shape, cfg, want_residual: bool = False) -> None:
+    """Warm the in-memory executables clean_cube will run for a
+    preprocessed cube of ``shape`` by a dummy call on device ZEROS — the
+    shapes are known from the archive header, so compilation can overlap
+    the host preprocessing instead of serializing after it.
+
+    A dummy run (not ``lower().compile()``) because the AOT path does NOT
+    seed the executable cache the normal call hits on this jax version
+    (measured: the post-AOT first call still pays its own
+    backend_compile); a same-aval call from this thread does.  On a zero
+    cube the loop converges after one iteration (zero template → amp=1 →
+    zero residual → NaN scalers → no flags → cycle hit), so the run cost
+    is noise next to the compile.  Mirrors clean_cube's route fallbacks
+    (pallas/incremental forced off for residual requests) so the warmed
+    executables are exactly the ones used.  The dummy buffers are forced
+    complete and dropped before returning."""
+    nsub, nchan, nbin = shape
+    dtype = _x64_dtype(cfg)
+    D = jnp.zeros((nsub, nchan, nbin), dtype)
+    w = jnp.zeros((nsub, nchan), dtype)
+    v = w != 0  # the real paths derive validity this way — warm that tiny
+    #             executable too, not just the big one
+    t = jnp.zeros((nbin,), dtype)
+    pr = tuple(cfg.pulse_region)
+    use_pallas = cfg.pallas and not want_residual
+    incremental = cfg.incremental_template and not want_residual
+    if cfg.fused:
+        out = fused_clean(
+            D, w, v, 5.0, 5.0, max_iter=int(cfg.max_iter), pulse_region=pr,
+            want_residual=want_residual, use_pallas=use_pallas,
+            incremental=incremental)
+        # Mirror run_fused's epilogue, including its history slice for the
+        # dummy run's own iteration count (the real archive's count may
+        # differ — that per-length slice is a ~tens-of-ms executable the
+        # real call compiles itself; warming all max_iter+1 variants would
+        # bloat the per-executable segfault budget for no real gain).
+        np.asarray(out[1])
+        np.asarray(out[6][: int(out[4]) + 1])
+    elif incremental:
+        np.asarray(dense_template(D, w))
+        np.asarray(advance_template(D, t, w, w))
+        out = step_from_template(
+            D, w, v, t, 5.0, 5.0, pulse_region=pr, use_pallas=use_pallas)
+        np.asarray(out[1])
+    else:
+        out = clean_step(
+            D, w, v, w, 5.0, 5.0, pulse_region=pr, use_pallas=use_pallas)
+        np.asarray(out[1])
+
+
+def start_precompile(shape, cfg, want_residual: bool = False):
+    """Fire the executable warmup on a daemon thread; returns the Thread to
+    join before the first device call (a still-in-flight warm call must not
+    race a duplicate compile from the real call), or None when trivially
+    inapplicable (non-jax backend, ICT_NO_PRECOMPILE=1, explicit
+    chunk_block).  Every check that touches the device — backend init,
+    device_memory_bytes, the >HBM routing guard, the dummy-headroom guard —
+    runs INSIDE the thread, so a cold backend initialization overlaps the
+    host preprocessing too instead of serializing before it.  Failures are
+    swallowed — the real call compiles normally."""
+    import os
+    import threading
+
+    if cfg.backend != "jax" or os.environ.get("ICT_NO_PRECOMPILE") == "1":
+        return None
+    if cfg.chunk_block:
+        return None
+
+    def _run():
+        try:
+            from iterative_cleaner_tpu.parallel.autoshard import (
+                HBM_USABLE_FRACTION,
+                chunk_block_subints,
+                device_memory_bytes,
+                working_set_bytes,
+            )
+
+            if cfg.auto_shard and chunk_block_subints(shape, cfg) is not None:
+                return  # >HBM: routes to sharded/chunked, not warmed here
+            hbm = device_memory_bytes()
+            itemsize = 8 if cfg.x64 else 4
+            if hbm is not None and (2 * working_set_bytes(shape, itemsize)
+                                    > hbm * HBM_USABLE_FRACTION):
+                # The dummy cube would crowd out the real one's headroom.
+                return
+            # Account the warm's executables BEFORE compiling them: a due
+            # compile-cache drop then lands here, not between the warm and
+            # the real call (which notes the identical key — a set, so no
+            # double count).
+            from iterative_cleaner_tpu.utils.compile_cache import (
+                inmemory_route_key,
+                note_compiled_shape,
+            )
+
+            note_compiled_shape(inmemory_route_key(shape, cfg, want_residual))
+            precompile_for(shape, cfg, want_residual)
+        except Exception:  # noqa: BLE001 — warmup only; real call recovers
+            pass
+
+    th = threading.Thread(target=_run, daemon=True, name="ict-precompile")
+    th.start()
+    return th
+
+
 @partial(jax.jit, static_argnames=(
     "max_iter", "pulse_region", "want_residual", "use_pallas", "incremental"))
 def fused_clean(

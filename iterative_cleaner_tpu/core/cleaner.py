@@ -22,7 +22,10 @@ import numpy as np
 
 from iterative_cleaner_tpu.config import CleanConfig
 from iterative_cleaner_tpu.backends.base import make_backend
-from iterative_cleaner_tpu.utils.compile_cache import note_compiled_shape
+from iterative_cleaner_tpu.utils.compile_cache import (
+    inmemory_route_key,
+    note_compiled_shape,
+)
 
 
 @dataclass
@@ -237,20 +240,12 @@ def clean_cube(
             for slab in slabs:
                 for fp in fps:
                     note_compiled_shape((*slab, *fp))
-        elif cfg.fused:
-            # fused_clean statics: max_iter, pulse_region, want_residual,
-            # use_pallas, incremental.
-            note_compiled_shape(
-                (nsub, nchan, nbin, "fused", cfg.pallas, cfg.x64,
-                 want_residual, cfg.max_iter, cfg.incremental_template, pr))
         else:
-            # clean_step statics are only (pulse_region, use_pallas): the
-            # same executable serves residual and non-residual requests.
-            # The incremental route swaps clean_step for the
-            # dense/advance/step_from_template executable set.
+            # Shared with the precompile warm path (which notes the same
+            # key BEFORE warming, so a due cache drop lands before the
+            # warm compiles rather than between warm and real call).
             note_compiled_shape(
-                (nsub, nchan, nbin, "stepwise", cfg.pallas, cfg.x64,
-                 cfg.incremental_template, pr))
+                inmemory_route_key((nsub, nchan, nbin), cfg, want_residual))
 
     if cfg.fused and chunk_block is None:
         from iterative_cleaner_tpu.backends.jax_backend import run_fused

@@ -56,7 +56,23 @@ class SurgicalCleaner:
 
     def clean(self, archive: Archive, progress: ProgressFn | None = None) -> SurgicalOutput:
         cfg = self.cfg
+        warm = None
+        if cfg.backend == "jax":
+            # The preprocessed-cube shape is known from the header alone,
+            # so XLA compilation overlaps the host preprocessing instead of
+            # serializing after it (cold-path latency = max, not sum).
+            from iterative_cleaner_tpu.backends.jax_backend import (
+                start_precompile,
+            )
+
+            shape = (archive.data.shape[0], archive.data.shape[2],
+                     archive.data.shape[3])
+            warm = start_precompile(shape, cfg, want_residual=cfg.unload_res)
         D, w0 = preprocess(archive)
+        if warm is not None:
+            # A still-compiling warmup must not race a duplicate compile
+            # from the real call below.
+            warm.join()
         result = clean_cube(D, w0, cfg, progress=progress, want_residual=cfg.unload_res)
 
         final_w = result.weights

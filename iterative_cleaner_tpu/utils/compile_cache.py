@@ -25,6 +25,28 @@ DISTINCT_SHAPE_LIMIT = 20  # matches the fuzz sweep's empirically safe cadence
 _seen: set[tuple] = set()
 
 
+def inmemory_route_key(shape, cfg, want_residual: bool) -> tuple:
+    """The compile-cache key for the IN-MEMORY route clean_cube will take —
+    shared by clean_cube's accounting and the precompile warm path so the
+    two can never disagree.  ``cfg`` must be the raw user config: the
+    pallas/incremental residual fallbacks are applied here, exactly as
+    clean_cube resolves them before keying."""
+    nsub, nchan, nbin = shape
+    pr = tuple(cfg.pulse_region)
+    pallas = cfg.pallas and not want_residual
+    incremental = cfg.incremental_template and not want_residual
+    if cfg.fused:
+        # fused_clean statics: max_iter, pulse_region, want_residual,
+        # use_pallas, incremental.
+        return (nsub, nchan, nbin, "fused", pallas, cfg.x64,
+                want_residual, cfg.max_iter, incremental, pr)
+    # clean_step statics are only (pulse_region, use_pallas): the same
+    # executable serves residual and non-residual requests.  The
+    # incremental route swaps clean_step for the dense/advance/
+    # step_from_template executable set.
+    return (nsub, nchan, nbin, "stepwise", pallas, cfg.x64, incremental, pr)
+
+
 def note_compiled_shape(key: tuple) -> bool:
     """Record a (shape, route-fingerprint) key about to be jit-compiled; drop
     JAX's compilation caches once ``DISTINCT_SHAPE_LIMIT`` distinct keys
