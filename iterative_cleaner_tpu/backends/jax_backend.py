@@ -236,16 +236,24 @@ def start_precompile(shape, cfg, want_residual: bool = False):
                                     > hbm * HBM_USABLE_FRACTION):
                 # The dummy cube would crowd out the real one's headroom.
                 return
-            # Account the warm's executables BEFORE compiling them: a due
-            # compile-cache drop then lands here, not between the warm and
-            # the real call (which notes the identical key — a set, so no
-            # double count).
             from iterative_cleaner_tpu.utils.compile_cache import (
+                already_noted,
                 inmemory_route_key,
                 note_compiled_shape,
             )
 
-            note_compiled_shape(inmemory_route_key(shape, cfg, want_residual))
+            key = inmemory_route_key(shape, cfg, want_residual)
+            if already_noted(key):
+                # Executables for this exact route already compiled in this
+                # process (and a cache drop clears _seen with them): a
+                # directory of same-shape archives must not pay a dummy
+                # cube allocation + run per archive.
+                return
+            # Account the warm's executables BEFORE compiling them: a due
+            # compile-cache drop then lands here, not between the warm and
+            # the real call (which notes the identical key — a set, so no
+            # double count).
+            note_compiled_shape(key)
             precompile_for(shape, cfg, want_residual)
         except Exception:  # noqa: BLE001 — warmup only; real call recovers
             pass

@@ -47,6 +47,13 @@ def inmemory_route_key(shape, cfg, want_residual: bool) -> tuple:
     return (nsub, nchan, nbin, "stepwise", pallas, cfg.x64, incremental, pr)
 
 
+def already_noted(key: tuple) -> bool:
+    """Whether this exact key was noted since the last cache drop — i.e.
+    its executables are (or are being) compiled in this process.  The warm
+    path uses it to skip redundant dummy runs for same-shape archives."""
+    return tuple(key) in _seen
+
+
 def note_compiled_shape(key: tuple) -> bool:
     """Record a (shape, route-fingerprint) key about to be jit-compiled; drop
     JAX's compilation caches once ``DISTINCT_SHAPE_LIMIT`` distinct keys

@@ -92,14 +92,23 @@ def test_surgical_cleaner_warms_on_thread(compile_events, monkeypatch):
         return orig(shape, cfg, want_residual=want_residual)
 
     monkeypatch.setattr(jax_backend, "start_precompile", spy)
+    warmed = []
+    orig_warm = jax_backend.precompile_for
+    monkeypatch.setattr(
+        jax_backend, "precompile_for",
+        lambda *a, **kw: (warmed.append(a), orig_warm(*a, **kw))[1])
     archive = make_archive(nsub=8, nchan=32, nbin=128, seed=22)
     out = SurgicalCleaner(CleanConfig(backend="jax", max_iter=3)).clean(archive)
     assert out.result.converged or out.result.loops == 3
     assert calls == [((8, 32, 128), False)]
+    assert len(warmed) == 1
     compile_events.clear()
-    # Same shape again: nothing left to compile anywhere.
+    # Same shape again: nothing left to compile anywhere, AND the warm
+    # skips its dummy run entirely (the route key is already accounted —
+    # a directory of same-shape archives must not pay a dummy per file).
     SurgicalCleaner(CleanConfig(backend="jax", max_iter=3)).clean(archive)
     assert _backend_compiles(compile_events) == []
+    assert len(warmed) == 1
 
 
 def test_warm_notes_route_key_before_compiling(monkeypatch):
