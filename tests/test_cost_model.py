@@ -1,0 +1,222 @@
+"""XLA cost-analysis evidence for the bandwidth claims behind the perf
+defaults (VERDICT r04 item 2).
+
+The incremental-template default (r04) and the cube-pass phase model
+(bench.py PHASE_CUBE_PASSES, docs/SCALING.md) rest on HBM-traffic
+arguments that two rounds of wedged tunnel kept from on-chip
+measurement.  These tests turn the prose model into CI-checked facts via
+the AOT path: ``jit(f).lower(...).compile()`` exposes XLA's own
+HloCostAnalysis ("bytes accessed") and the buffer assignment
+(``memory_analysis()``) — computed by the compiler itself, no hardware
+required.
+
+Accounting rules that shape the assertions (verified empirically on this
+jax/CPU backend):
+
+- The CPU backend fuses less than TPU, so elementwise temporaries count a
+  write+read each and absolute pass counts exceed the 8-pass TPU model.
+  Claims are therefore asserted as *differences between lowerings of the
+  same route* (unfused inflation cancels) or as generous regression bands
+  (a new accidental cube-sized copy moves the count by whole cubes).
+- ``lax.cond`` is costed over BOTH branches, and a gather is costed as a
+  full read of its operand — so the sparse advance looks cube-sized
+  *statically*.  Which branch actually runs is proven by value identity
+  instead (the sparse result is derived from T_prev, which the dense
+  rebuild ignores — the two are distinguishable by construction).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bench import PHASE_CUBE_PASSES
+from iterative_cleaner_tpu.backends import jax_backend as jb
+
+PR = (0.0, 0.0, 1.0)  # pulse_region inactive (the reference default)
+
+
+def _cube_bytes(shape) -> float:
+    nsub, nchan, nbin = shape
+    return float(nsub * nchan * nbin * 4)
+
+
+def _bytes_accessed(lowered) -> float:
+    ca = lowered.compile().cost_analysis()
+    if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+        ca = ca[0]
+    return float(ca["bytes accessed"])
+
+
+def _mem_cubes(lowered, shape) -> float:
+    """Peak working set (args + outputs + temps) in cube units from XLA's
+    buffer assignment."""
+    ma = lowered.compile().memory_analysis()
+    total = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+             + ma.temp_size_in_bytes)
+    return total / _cube_bytes(shape)
+
+
+def _abstract_args(shape):
+    nsub, nchan, nbin = shape
+    D = jnp.zeros(shape, jnp.float32)
+    w = jnp.zeros((nsub, nchan), jnp.float32)
+    v = w != 0
+    t = jnp.zeros((nbin,), jnp.float32)
+    return D, w, v, t
+
+
+@functools.lru_cache(maxsize=None)
+def _step_cubes(shape) -> dict:
+    """Bytes accessed (in cube units) for the per-iteration executables of
+    the dense and incremental stepwise routes.  Cached: the AOT
+    lower().compile() path bypasses the jit executable cache (see the
+    precompile_for note in jax_backend.py), so each call would recompile."""
+    D, w, v, t = _abstract_args(shape)
+    cube = _cube_bytes(shape)
+    dense = _bytes_accessed(jb.clean_step.lower(
+        D, w, v, w, 5.0, 5.0, pulse_region=PR, use_pallas=False))
+    incr = _bytes_accessed(jb.step_from_template.lower(
+        D, w, v, t, 5.0, 5.0, pulse_region=PR, use_pallas=False))
+    tmpl = _bytes_accessed(jb.dense_template.lower(D, w))
+    return {"dense": dense / cube, "incr": incr / cube, "tmpl": tmpl / cube}
+
+
+SHAPE = (32, 64, 256)
+
+
+def test_incremental_step_reads_at_least_one_cube_less():
+    """The core claim behind the r04 default: carrying the template across
+    iterations removes the template build's full-cube read from the
+    per-iteration executable.  Asserted as a difference, which cancels the
+    CPU backend's unfused-temp inflation: whatever the lowering, the dense
+    step must read the cube for its template at least once more than the
+    template-given step (ref: the per-iteration rebuild it replaces,
+    iterative_cleaner.py:88-93)."""
+    c = _step_cubes(SHAPE)
+    saved = c["dense"] - c["incr"]
+    assert saved >= 0.99, (
+        f"dense step {c['dense']:.2f} cubes vs incremental {c['incr']:.2f}: "
+        f"saved only {saved:.2f} — the incremental default's justification")
+    # ... and the saving is exactly the dense template build, not an
+    # unrelated lowering artifact (tolerance: weights-array traffic).
+    assert saved == pytest.approx(c["tmpl"], rel=0.05)
+
+
+def test_step_traffic_tracks_the_documented_phase_model():
+    """bench.py's PHASE_CUBE_PASSES (the basis for every phase_gbps figure
+    and the SCALING.md narrative) models the TPU step at 8 cube passes.
+    On the less-fused CPU lowering that model is a floor, not an exact
+    count; the ceiling sits 1.5 passes above the 20.6 cubes measured on
+    jax 0.7/CPU at adoption time, so one new cube-sized copy (>= 2
+    passes unfused) trips it while leaving room for lowering noise."""
+    model = sum(PHASE_CUBE_PASSES.values())
+    assert model == 8.0  # the documented model itself (SCALING.md)
+    c = _step_cubes(SHAPE)
+    assert model <= c["dense"] <= 22.1, c
+
+
+def test_step_traffic_scales_linearly_with_cube_size():
+    """The step is bandwidth-bound by design: bytes accessed must scale
+    with the cube, not faster (a superlinear term would mean some phase
+    re-reads the cube per-bin or per-profile)."""
+    small, big = _step_cubes((32, 64, 128)), _step_cubes((32, 64, 512))
+    assert big["dense"] == pytest.approx(small["dense"], rel=0.10)
+    assert big["incr"] == pytest.approx(small["incr"], rel=0.10)
+
+
+def test_fused_loop_body_does_not_regress_step_traffic():
+    """--fused runs the same iteration inside lax.while_loop; its whole-
+    program bytes must stay at-or-below one stepwise iteration's plus the
+    (grid-sized, not cube-sized) history bookkeeping — the loop body is
+    costed once, so a cube-sized leak into the carry shows up here."""
+    D, w, v, _ = _abstract_args(SHAPE)
+    cube = _cube_bytes(SHAPE)
+    fused = _bytes_accessed(jb.fused_clean.lower(
+        D, w, v, 5.0, 5.0, max_iter=5, pulse_region=PR,
+        want_residual=False, use_pallas=False, incremental=False)) / cube
+    step = _step_cubes(SHAPE)["dense"]
+    assert fused <= step + 0.5, (fused, step)
+
+
+class TestSparseBranchRuntimeSelection:
+    """lax.cond's static cost covers both branches; these pin which branch
+    EXECUTES.  T_prev is deliberately not a real template (zeros), so the
+    sparse result (T_prev + sum dw*profile) and the dense rebuild
+    (weights . D, independent of T_prev) are distinguishable by value."""
+
+    def _data(self, nsub=16, nchan=64, nbin=128, seed=3):
+        rng = np.random.default_rng(seed)
+        D = jnp.asarray(rng.normal(size=(nsub, nchan, nbin)), jnp.float32)
+        w = jnp.ones((nsub, nchan), jnp.float32)
+        assert w.size > jb.INCREMENTAL_TEMPLATE_BUDGET  # fallback reachable
+        return D, w
+
+    def test_under_budget_takes_the_sparse_path(self):
+        D, w_prev = self._data()
+        t0 = jnp.zeros((D.shape[-1],), jnp.float32)
+        new_w = np.asarray(w_prev).copy()
+        new_w[0, 0] = 0.0
+        new_w[3, 7] = 0.0
+        new_w = jnp.asarray(new_w)
+        got = np.asarray(jb.advance_template(D, t0, w_prev, new_w))
+        # The sparse-branch spec: T_prev plus the flipped profiles' delta.
+        expect = np.asarray(t0) - np.asarray(D[0, 0] + D[3, 7])
+        np.testing.assert_allclose(got, expect, rtol=1e-6, atol=1e-6)
+        dense = np.asarray(jb.dense_template(D, new_w))
+        assert not np.allclose(got, dense), (
+            "result matches the dense rebuild — the cond took the dense "
+            "branch on an under-budget update")
+
+    def test_over_budget_falls_back_to_dense(self):
+        D, w_prev = self._data()
+        t0 = jnp.zeros((D.shape[-1],), jnp.float32)
+        new_w = np.asarray(w_prev).copy()
+        new_w.reshape(-1)[: jb.INCREMENTAL_TEMPLATE_BUDGET + 88] = 0.0
+        new_w = jnp.asarray(new_w)
+        got = np.asarray(jb.advance_template(D, t0, w_prev, new_w))
+        np.testing.assert_array_equal(
+            got, np.asarray(jb.dense_template(D, new_w)))
+
+    def test_nonfinite_candidate_falls_back_to_dense(self):
+        D, w_prev = self._data()
+        D = D.at[2, 5, :].set(jnp.inf)
+        w_prev = w_prev.at[2, 5].set(0.0)  # inf profile enters the support
+        t0 = jnp.zeros((D.shape[-1],), jnp.float32)
+        new_w = w_prev.at[2, 5].set(1.0)
+        got = np.asarray(jb.advance_template(D, t0, w_prev, new_w))
+        np.testing.assert_array_equal(
+            got, np.asarray(jb.dense_template(D, new_w)))
+
+
+class TestWorkingSetFactor:
+    """XLA's buffer assignment vs autoshard's PEAK_CUBE_FACTOR guess.
+    The CPU assignment is an upper-ish bound (less fusion than TPU ->
+    more live temps); on TPU bench.py reports the chip's own number as
+    peak_cube_factor_static.  These bands catch the regression that
+    matters either way: a new cube-sized buffer in the benchmark kernel
+    moves the factor by ~1.0."""
+
+    def test_fused_kernel_working_set(self):
+        D, w, v, _ = _abstract_args(SHAPE)
+        f = _mem_cubes(jb.fused_clean.lower(
+            D, w, v, 5.0, 5.0, max_iter=5, pulse_region=PR,
+            want_residual=False, use_pallas=False, incremental=True), SHAPE)
+        assert f <= 4.5, f  # measured 4.05 on jax 0.7/CPU at adoption
+
+    def test_residual_request_costs_a_cube(self):
+        """want_residual carries a D-sized buffer through the loop — the
+        reason the benchmark configuration runs without it
+        (jax_backend.fused_clean docstring)."""
+        D, w, v, _ = _abstract_args(SHAPE)
+        kw = dict(max_iter=5, pulse_region=PR, use_pallas=False,
+                  incremental=False)
+        without = _mem_cubes(jb.fused_clean.lower(
+            D, w, v, 5.0, 5.0, want_residual=False, **kw), SHAPE)
+        with_res = _mem_cubes(jb.fused_clean.lower(
+            D, w, v, 5.0, 5.0, want_residual=True, **kw), SHAPE)
+        assert with_res - without >= 0.9

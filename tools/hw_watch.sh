@@ -9,6 +9,7 @@
 # /tmp/hw_watch.log — runtime telemetry stays out of the tree; only the
 # produced bench/probe artifacts under docs/ are worth versioning)
 set -u
+ROUND="${ROUND:-r05}"
 cd "$(dirname "$0")/.."
 LOG=/tmp/hw_watch.log
 probe() {
@@ -30,11 +31,11 @@ while true; do
         sleep 2
         note "probe_template_perf start"
         timeout 1200 python tools/probe_template_perf.py \
-            > docs/probe_r04_hw.txt 2>&1
+            > docs/probe_${ROUND}_hw.txt 2>&1
         note "probe_template_perf rc=$?"
         note "bench (skip chunked) start"
         BENCH_SKIP_CHUNKED=1 BENCH_WATCHDOG_S=1500 timeout 1800 \
-            python bench.py > docs/bench_r04_hw.json 2> docs/bench_r04_hw.log
+            python bench.py > docs/bench_${ROUND}_hw.json 2> docs/bench_${ROUND}_hw.log
         note "bench rc=$?"
         # second pass: chunked section only, if the window survived
         plat2="$(probe)"
@@ -42,8 +43,8 @@ while true; do
             note "window still healthy — chunked pass"
             BENCH_SKIP_NORTHSTAR=1 BENCH_SKIP_PHASES=1 BENCH_SKIP_PALLAS=1 \
                 BENCH_FULL_NUMPY=0 BENCH_WATCHDOG_S=1500 timeout 1800 \
-                python bench.py > docs/bench_r04_hw_chunked.json \
-                2> docs/bench_r04_hw_chunked.log
+                python bench.py > docs/bench_${ROUND}_hw_chunked.json \
+                2> docs/bench_${ROUND}_hw_chunked.log
             note "chunked bench rc=$?"
         else
             note "window closed before chunked pass (plat='$plat2')"
