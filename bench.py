@@ -77,6 +77,29 @@ def log(msg: str) -> None:
 
 def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
+    # The driver records only a tail of stdout, and r04's official artifact
+    # lost its payload to exactly that truncation (ADVICE r04): mirror the
+    # full JSON into the tree, keyed by platform so a CPU test run can
+    # never clobber a real-TPU artifact.
+    try:
+        plat = str(payload.get("device", "unknown")).split(":", 1)[0]
+        # Role tag (BENCH_MIRROR_TAG, e.g. hw_watch's chunked-only second
+        # pass) and error payloads get their own filenames so a partial or
+        # watchdog emit can never clobber the last COMPLETE same-platform
+        # artifact — the exact loss mode this mirror exists to prevent.
+        name = f"bench_last_{plat or 'unknown'}"
+        tag = os.environ.get("BENCH_MIRROR_TAG", "")
+        if tag:
+            name += f"_{tag}"
+        if "error" in payload:
+            name += "_error"
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "docs", name + ".json")
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+    except Exception:  # noqa: BLE001 — the stdout line is the contract
+        pass
 
 
 def _headline(payload: dict) -> dict:
@@ -464,6 +487,72 @@ def _bench_pallas(state) -> dict:
     return res
 
 
+def _bench_static_analysis() -> dict:
+    """XLA's own static accounting of the benchmark executables on THIS
+    backend, via the AOT path (ShapeDtypeStruct avals — no device buffers
+    are allocated, but the compile runs on the benched backend, so on TPU
+    these numbers reflect real fusion and the chip's buffer assignment
+    rather than the CPU approximation tests/test_cost_model.py pins).
+    Records the two facts the perf defaults rest on: (a) the incremental
+    route's per-iteration executable reads one template cube-pass fewer
+    than the dense step (the r04 default's justification), and (b) the
+    fused kernel's working-set factor next to autoshard.PEAK_CUBE_FACTOR.
+    """
+    import jax
+
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        clean_step,
+        fused_clean,
+        step_from_template,
+    )
+    from iterative_cleaner_tpu.parallel.autoshard import PEAK_CUBE_FACTOR
+
+    shape = (64, 256, 512)
+    nsub, nchan, nbin = shape
+    cube = float(nsub * nchan * nbin * 4)
+    D = jax.ShapeDtypeStruct(shape, np.float32)
+    w = jax.ShapeDtypeStruct((nsub, nchan), np.float32)
+    v = jax.ShapeDtypeStruct((nsub, nchan), np.bool_)
+    t = jax.ShapeDtypeStruct((nbin,), np.float32)
+    s = jax.ShapeDtypeStruct((), np.float32)
+    pr = (0.0, 0.0, 1.0)
+
+    def cost_cubes(compiled):
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+            ca = ca[0]
+        return round(float(ca["bytes accessed"]) / cube, 2)
+
+    dense = cost_cubes(clean_step.lower(
+        D, w, v, w, s, s, pulse_region=pr, use_pallas=False).compile())
+    incr = cost_cubes(step_from_template.lower(
+        D, w, v, t, s, s, pulse_region=pr, use_pallas=False).compile())
+    fused = fused_clean.lower(
+        D, w, v, s, s, max_iter=MAX_ITER, pulse_region=pr,
+        want_residual=False, use_pallas=False, incremental=True).compile()
+    res = {
+        "backend": jax.default_backend(),
+        "shape": list(shape),
+        "step_dense_bytes_cubes": dense,
+        "step_incremental_bytes_cubes": incr,
+        "incremental_saves_cubes": round(dense - incr, 2),
+        "fused_bytes_cubes": cost_cubes(fused),
+    }
+    try:
+        ma = fused.memory_analysis()
+        ws = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+              + ma.temp_size_in_bytes) / cube
+        res["peak_cube_factor_static"] = round(ws, 2)
+        res["peak_cube_factor_routing_constant"] = PEAK_CUBE_FACTOR
+    except Exception as exc:  # noqa: BLE001 — cost half still valuable
+        res["memory_analysis_error"] = str(exc)
+    log(f"[static] XLA accounting ({res['backend']}): dense step {dense} "
+        f"cubes vs incremental {incr} (saves {res['incremental_saves_cubes']}"
+        f"); fused working set {res.get('peak_cube_factor_static')} cubes "
+        f"(routing constant {PEAK_CUBE_FACTOR})")
+    return res
+
+
 def _bench_peak_factor(state, dev) -> dict:
     """Empirically derive autoshard.PEAK_CUBE_FACTOR when memory_stats()
     reports nothing (the axon platform): two bisections against real
@@ -803,6 +892,17 @@ def run_bench() -> dict:
     if os.environ.get("BENCH_SKIP_CHUNKED", "0") == "0":
         run_section("chunked", lambda: _bench_chunked(
             state, out_a.get("upload_gbps", 0.0)))
+
+    if os.environ.get("BENCH_SKIP_STATIC", "0") == "0":
+        # Static XLA accounting (cost analysis + buffer assignment) of the
+        # executables the defaults rest on.  No device data moves; the cost
+        # is ~3 AOT compiles on the benched backend.  Placed after the
+        # timing sections: on a flaky tunnel a compile can hang, and these
+        # numbers are reproducible offline while the timings are not.
+        run_section("static_analysis", _bench_static_analysis)
+        sa = _PAYLOAD.get("static_analysis", {})
+        if isinstance(sa, dict) and "peak_cube_factor_static" in sa:
+            _PAYLOAD["peak_cube_factor_static"] = sa["peak_cube_factor_static"]
 
     if (os.environ.get("BENCH_PROBE_PEAK", "1") != "0"
             and "peak_cube_factor_measured" not in out_a

@@ -42,6 +42,7 @@ while true; do
         if [ "$plat2" = "tpu" ]; then
             note "window still healthy — chunked pass"
             BENCH_SKIP_NORTHSTAR=1 BENCH_SKIP_PHASES=1 BENCH_SKIP_PALLAS=1 \
+                BENCH_SKIP_STATIC=1 BENCH_MIRROR_TAG=chunked \
                 BENCH_FULL_NUMPY=0 BENCH_WATCHDOG_S=1500 timeout 1800 \
                 python bench.py > docs/bench_${ROUND}_hw_chunked.json \
                 2> docs/bench_${ROUND}_hw_chunked.log
