@@ -29,6 +29,9 @@ fully-masked profiles are never flagged (§8.L3).
 
 from __future__ import annotations
 
+import string
+
+import jax
 import jax.numpy as jnp
 
 from iterative_cleaner_tpu.ops.masked import masked_median, nan_propagating_median
@@ -184,10 +187,90 @@ def comprehensive_stats(
         d_std, d_mean, d_ptp, d_fft, valid, chanthresh, subintthresh)
 
 
+def _fft_diag_impl(centred: jnp.ndarray) -> jnp.ndarray:
+    return jnp.max(jnp.abs(jnp.fft.rfft(centred, axis=-1)), axis=-1)
+
+
+# XLA's SPMD partitioner cannot partition the FFT op: on a sharded cube it
+# inserts a chain of all-gathers that materialises the FULL global cube on
+# every device before one replicated fft — found by static analysis of the
+# sharded lowering (the three cube-scale all-gathers all fed %fft), and
+# fatal to the >HBM sharded route, whose whole point is that no single
+# chip can hold the cube.  The diagnostic reduces along the BIN axis,
+# which batch_spec never shards, so it is embarrassingly parallel across
+# profiles: custom_partitioning tells the partitioner to keep the leading
+# dims sharded as-is (bin axis replicated) and run the local rfft per
+# shard — bitwise-identical values, zero collective traffic.  Pinned by
+# tests/test_cost_model.py::test_sharded_lowering_never_gathers_the_cube.
+#
+# custom_partitioning has no batching rule, and the sharded batch path is
+# vmap(fused_clean); rank-specific instances dispatched through
+# custom_vmap restore composition (each vmap level promotes to the
+# next-rank instance, so nested vmap — the sweep grid — works too).
+_fft_diag_instances: dict = {}
+
+
+def _fft_diag_instance(ndim: int):
+    inst = _fft_diag_instances.get(ndim)
+    if inst is not None:
+        return inst
+    from jax.experimental.custom_partitioning import (
+        SdyShardingRule,
+        custom_partitioning,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def _supported(sharding, aval):
+        """The operand sharding we can execute locally: leading dims as the
+        operand already is, bin axis replicated."""
+        spec = list(sharding.spec) + [None] * (aval.ndim - len(sharding.spec))
+        spec = spec[: aval.ndim]
+        spec[-1] = None
+        return NamedSharding(sharding.mesh, PartitionSpec(*spec))
+
+    def _partition(mesh, arg_shapes, result_shape):
+        in_sh = _supported(arg_shapes[0].sharding, arg_shapes[0])
+        out_sh = NamedSharding(in_sh.mesh,
+                               PartitionSpec(*list(in_sh.spec)[:-1]))
+        return mesh, _fft_diag_impl, out_sh, (in_sh,)
+
+    def _infer(mesh, arg_shapes, result_shape):
+        in_sh = _supported(arg_shapes[0].sharding, arg_shapes[0])
+        return NamedSharding(in_sh.mesh, PartitionSpec(*list(in_sh.spec)[:-1]))
+
+    inst = custom_partitioning(_fft_diag_impl)
+    dims = tuple(string.ascii_lowercase[:ndim])
+    inst.def_partition(
+        partition=_partition,
+        infer_sharding_from_operands=_infer,
+        # Shardy (the jax>=0.9 default partitioner) reads this rule instead
+        # of the GSPMD callbacks: every leading dim propagates, bins stay
+        # whole.
+        sharding_rule=SdyShardingRule((dims,), (dims[:-1],)),
+    )
+    _fft_diag_instances[ndim] = inst
+    return inst
+
+
+@jax.custom_batching.custom_vmap
 def fft_diagnostic(centred: jnp.ndarray) -> jnp.ndarray:
     """max |rfft| over the bin axis of the centred residuals — the mask-blind
-    diagnostic #4 (§8.L1); shared by the XLA and Pallas-fused paths."""
-    return jnp.max(jnp.abs(jnp.fft.rfft(centred, axis=-1)), axis=-1)
+    diagnostic #4 (§8.L1, reference iterative_cleaner.py:209-211); shared by
+    the XLA and Pallas-fused paths.  Partition-aware: see the note above."""
+    return _fft_diag_instance(centred.ndim)(centred)
+
+
+@fft_diagnostic.def_vmap
+def _fft_diagnostic_vmap(axis_size, in_batched, centred):
+    del axis_size
+    batched, = in_batched
+    if not batched:
+        # vmap over other arguments only (the --sweep threshold grid): the
+        # cube is broadcast, not batched.
+        return fft_diagnostic(centred), False
+    # custom_vmap delivers the batch axis at position 0; the diagnostic is
+    # rank-polymorphic, so the batched call is just the next-rank instance.
+    return fft_diagnostic(centred), True
 
 
 def scale_and_combine(

@@ -41,21 +41,20 @@ PR = (0.0, 0.0, 1.0)  # pulse_region inactive (the reference default)
 
 
 def _cube_bytes(shape) -> float:
-    nsub, nchan, nbin = shape
-    return float(nsub * nchan * nbin * 4)
+    return float(np.prod(shape) * 4)
 
 
-def _bytes_accessed(lowered) -> float:
-    ca = lowered.compile().cost_analysis()
+def _bytes_accessed(compiled) -> float:
+    ca = compiled.cost_analysis()
     if isinstance(ca, (list, tuple)):  # older jax returns [dict]
         ca = ca[0]
     return float(ca["bytes accessed"])
 
 
-def _mem_cubes(lowered, shape) -> float:
+def _mem_cubes(compiled, shape) -> float:
     """Peak working set (args + outputs + temps) in cube units from XLA's
     buffer assignment."""
-    ma = lowered.compile().memory_analysis()
+    ma = compiled.memory_analysis()
     total = (ma.argument_size_in_bytes + ma.output_size_in_bytes
              + ma.temp_size_in_bytes)
     return total / _cube_bytes(shape)
@@ -79,10 +78,10 @@ def _step_cubes(shape) -> dict:
     D, w, v, t = _abstract_args(shape)
     cube = _cube_bytes(shape)
     dense = _bytes_accessed(jb.clean_step.lower(
-        D, w, v, w, 5.0, 5.0, pulse_region=PR, use_pallas=False))
+        D, w, v, w, 5.0, 5.0, pulse_region=PR, use_pallas=False).compile())
     incr = _bytes_accessed(jb.step_from_template.lower(
-        D, w, v, t, 5.0, 5.0, pulse_region=PR, use_pallas=False))
-    tmpl = _bytes_accessed(jb.dense_template.lower(D, w))
+        D, w, v, t, 5.0, 5.0, pulse_region=PR, use_pallas=False).compile())
+    tmpl = _bytes_accessed(jb.dense_template.lower(D, w).compile())
     return {"dense": dense / cube, "incr": incr / cube, "tmpl": tmpl / cube}
 
 
@@ -138,7 +137,8 @@ def test_fused_loop_body_does_not_regress_step_traffic():
     cube = _cube_bytes(SHAPE)
     fused = _bytes_accessed(jb.fused_clean.lower(
         D, w, v, 5.0, 5.0, max_iter=5, pulse_region=PR,
-        want_residual=False, use_pallas=False, incremental=False)) / cube
+        want_residual=False, use_pallas=False,
+        incremental=False).compile()) / cube
     step = _step_cubes(SHAPE)["dense"]
     assert fused <= step + 0.5, (fused, step)
 
@@ -193,6 +193,106 @@ class TestSparseBranchRuntimeSelection:
             got, np.asarray(jb.dense_template(D, new_w)))
 
 
+class TestShardedTraffic:
+    """The >HBM sharded route's whole justification is that per-device
+    traffic and memory scale with the SHARD, not the global cube.  Before
+    r05 that was false: XLA's SPMD partitioner cannot partition the FFT
+    op, so it all-gathered the FULL cube onto every device each iteration
+    (three cube-scale gathers feeding one replicated fft) — fatal at the
+    route's target scale (the 17 GB stress cube would have needed ~2.3
+    cubes of HBM per chip) and invisible on the virtual CPU mesh, where
+    all 8 "devices" share host memory.  ops/stats.fft_diagnostic is now
+    custom-partitioned (bin-axis reduction, bins never sharded → local
+    rfft per shard, bitwise-identical values); these bounds pin the
+    per-device lowering so the gather can never silently return."""
+
+    SHAPE4 = (2, 64, 128, 256)  # (archives, nsub, nchan, nbin)
+
+    def _compiled(self, sharded: bool):
+        from jax.sharding import NamedSharding
+
+        from iterative_cleaner_tpu.parallel import sharded as sh
+        from iterative_cleaner_tpu.parallel.mesh import make_mesh
+
+        a, s, c, b = self.SHAPE4
+
+        def aval(shape, dtype):
+            if not sharded:
+                return jax.ShapeDtypeStruct(shape, dtype)
+            mesh = make_mesh()
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=NamedSharding(
+                mesh, sh.batch_spec(shape, mesh)))
+
+        return sh.batched_fused_clean.lower(
+            aval((a, s, c, b), np.float32),
+            aval((a, s, c), np.float32),
+            aval((a, s, c), np.bool_),
+            5.0, 5.0, max_iter=5, pulse_region=PR).compile()
+
+    @staticmethod
+    def _gather_bytes(hlo_text) -> list:
+        """Byte sizes of every all-gather result in an HLO dump.  Line
+        shape: `%all-gather.15 = f32[1,32,128,256]{3,1,0,2}
+        all-gather(...)` — the result shape FOLLOWS the `=`."""
+        import re
+
+        itemsize = {"f64": 8, "c64": 8, "f32": 4, "s32": 4, "u32": 4,
+                    "c128": 16, "pred": 1}
+        out = []
+        for dt, dims in re.findall(r"= (\w+)\[([\d,]*)\]\S* all-gather\(",
+                                   hlo_text):
+            n = itemsize.get(dt, 4)
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            out.append((n, dt, dims))
+        return out
+
+    def test_gather_detector_detects(self):
+        """Negative control for the guard below: on a lowering that uses
+        the UNpartitioned fft, the detector must find the cube-scale
+        gather — if the HLO text format drifts, this fails instead of the
+        guard going silently vacuous (which is how the guard's first
+        version shipped broken)."""
+        from jax.sharding import NamedSharding
+
+        from iterative_cleaner_tpu.ops import stats
+        from iterative_cleaner_tpu.parallel import sharded as sh
+        from iterative_cleaner_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+        aval = jax.ShapeDtypeStruct(
+            self.SHAPE4, np.float32,
+            sharding=NamedSharding(mesh, sh.batch_spec(self.SHAPE4, mesh)))
+        txt = jax.jit(stats._fft_diag_impl).lower(aval).compile().as_text()
+        cube = _cube_bytes(self.SHAPE4)
+        big = [g for g in self._gather_bytes(txt) if g[0] > 0.05 * cube]
+        assert big, "detector failed to flag the unpartitioned-fft gather"
+
+    def test_sharded_lowering_never_gathers_the_cube(self):
+        cube = _cube_bytes(self.SHAPE4)
+        txt = self._compiled(sharded=True).as_text()
+        big = [g for g in self._gather_bytes(txt) if g[0] > 0.05 * cube]
+        assert not big, (
+            f"cube-scale all-gather back in the sharded lowering: {big}")
+        # Sanity that the program is genuinely distributed, not replicated:
+        # the template reduction must still cross shards.
+        assert "all-reduce" in txt
+
+    def test_sharded_per_device_traffic_and_memory_divide(self):
+        """Per-device cost on the 8-way mesh vs the same program unsharded:
+        ideal is 1/8 for both; the bound leaves room for the grid-sized
+        collectives and per-shard fixed costs (measured 0.13x bytes and
+        0.13x working set at adoption)."""
+        unsh = self._compiled(sharded=False)
+        shd = self._compiled(sharded=True)
+        assert _bytes_accessed(shd) <= 0.25 * _bytes_accessed(unsh), (
+            _bytes_accessed(shd), _bytes_accessed(unsh))
+        shd_mem = _mem_cubes(shd, self.SHAPE4)
+        unsh_mem = _mem_cubes(unsh, self.SHAPE4)
+        assert shd_mem <= 0.25 * unsh_mem, (shd_mem, unsh_mem)
+
+
 class TestWorkingSetFactor:
     """XLA's buffer assignment vs autoshard's PEAK_CUBE_FACTOR guess.
     The CPU assignment is an upper-ish bound (less fusion than TPU ->
@@ -205,7 +305,8 @@ class TestWorkingSetFactor:
         D, w, v, _ = _abstract_args(SHAPE)
         f = _mem_cubes(jb.fused_clean.lower(
             D, w, v, 5.0, 5.0, max_iter=5, pulse_region=PR,
-            want_residual=False, use_pallas=False, incremental=True), SHAPE)
+            want_residual=False, use_pallas=False,
+            incremental=True).compile(), SHAPE)
         assert f <= 4.5, f  # measured 4.05 on jax 0.7/CPU at adoption
 
     def test_residual_request_costs_a_cube(self):
@@ -216,7 +317,7 @@ class TestWorkingSetFactor:
         kw = dict(max_iter=5, pulse_region=PR, use_pallas=False,
                   incremental=False)
         without = _mem_cubes(jb.fused_clean.lower(
-            D, w, v, 5.0, 5.0, want_residual=False, **kw), SHAPE)
+            D, w, v, 5.0, 5.0, want_residual=False, **kw).compile(), SHAPE)
         with_res = _mem_cubes(jb.fused_clean.lower(
-            D, w, v, 5.0, 5.0, want_residual=True, **kw), SHAPE)
+            D, w, v, 5.0, 5.0, want_residual=True, **kw).compile(), SHAPE)
         assert with_res - without >= 0.9
