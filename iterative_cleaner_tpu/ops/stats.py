@@ -228,15 +228,20 @@ def _fft_diag_instance(ndim: int):
         spec[-1] = None
         return NamedSharding(sharding.mesh, PartitionSpec(*spec))
 
-    def _partition(mesh, arg_shapes, result_shape):
+    def _shardings(arg_shapes):
+        """(input, output) shardings for the local lowering: the output
+        drops the reduced bin axis from the supported input sharding."""
         in_sh = _supported(arg_shapes[0].sharding, arg_shapes[0])
         out_sh = NamedSharding(in_sh.mesh,
                                PartitionSpec(*list(in_sh.spec)[:-1]))
+        return in_sh, out_sh
+
+    def _partition(mesh, arg_shapes, result_shape):
+        in_sh, out_sh = _shardings(arg_shapes)
         return mesh, _fft_diag_impl, out_sh, (in_sh,)
 
     def _infer(mesh, arg_shapes, result_shape):
-        in_sh = _supported(arg_shapes[0].sharding, arg_shapes[0])
-        return NamedSharding(in_sh.mesh, PartitionSpec(*list(in_sh.spec)[:-1]))
+        return _shardings(arg_shapes)[1]
 
     inst = custom_partitioning(_fft_diag_impl)
     dims = tuple(string.ascii_lowercase[:ndim])

@@ -26,6 +26,7 @@ def _run_bench(extra_env: dict, timeout: int = 540):
         JAX_PLATFORMS="cpu",
         PYTHONPATH=REPO,
         BENCH_WATCHDOG_S="480",
+        BENCH_MIRROR="0",  # failure-path tests must not litter docs/
         **extra_env,
     )
     out = subprocess.run(

@@ -208,13 +208,17 @@ class TestShardedTraffic:
 
     SHAPE4 = (2, 64, 128, 256)  # (archives, nsub, nchan, nbin)
 
-    def _compiled(self, sharded: bool):
+    @staticmethod
+    @functools.lru_cache(maxsize=None)
+    def _compiled(sharded: bool):
+        # Cached like _step_cubes: AOT lower().compile() bypasses the jit
+        # executable cache, and two tests need each program.
         from jax.sharding import NamedSharding
 
         from iterative_cleaner_tpu.parallel import sharded as sh
         from iterative_cleaner_tpu.parallel.mesh import make_mesh
 
-        a, s, c, b = self.SHAPE4
+        a, s, c, b = TestShardedTraffic.SHAPE4
 
         def aval(shape, dtype):
             if not sharded:
@@ -281,16 +285,20 @@ class TestShardedTraffic:
 
     def test_sharded_per_device_traffic_and_memory_divide(self):
         """Per-device cost on the 8-way mesh vs the same program unsharded:
-        ideal is 1/8 for both; the bound leaves room for the grid-sized
-        collectives and per-shard fixed costs (measured 0.13x bytes and
-        0.13x working set at adoption)."""
+        ideal is 1/8 for both; measured 0.13x bytes and 0.13x working set
+        at adoption.  The 0.17x bounds leave ~30% headroom over measured
+        while staying tight enough to catch the two known regressions:
+        the unpartitioned-fft gather (0.40x bytes, 0.56x mem) and flipping
+        the sharded route onto the incremental template, whose flat-index
+        gather costs a quarter-cube all-gather per iteration (0.23x bytes,
+        0.19x mem — the measured reason SCALING.md keeps sharded dense)."""
         unsh = self._compiled(sharded=False)
         shd = self._compiled(sharded=True)
-        assert _bytes_accessed(shd) <= 0.25 * _bytes_accessed(unsh), (
+        assert _bytes_accessed(shd) <= 0.17 * _bytes_accessed(unsh), (
             _bytes_accessed(shd), _bytes_accessed(unsh))
         shd_mem = _mem_cubes(shd, self.SHAPE4)
         unsh_mem = _mem_cubes(unsh, self.SHAPE4)
-        assert shd_mem <= 0.25 * unsh_mem, (shd_mem, unsh_mem)
+        assert shd_mem <= 0.17 * unsh_mem, (shd_mem, unsh_mem)
 
 
 class TestWorkingSetFactor:
