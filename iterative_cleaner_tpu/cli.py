@@ -179,11 +179,18 @@ def main(argv: list[str] | None = None) -> int:
         # A wedged remote-TPU tunnel hangs the first in-process jax call
         # forever; probe killably and demote to CPU loudly instead
         # (utils/device_probe.py — no-op when already pinned to CPU).
+        from iterative_cleaner_tpu.utils.compile_cache import (
+            enable_persistent_cache,
+        )
         from iterative_cleaner_tpu.utils.device_probe import (
             ensure_responsive_backend,
         )
 
         ensure_responsive_backend()
+        # Cross-process executable reuse: a repeat clean of any
+        # previously-seen shape skips its cold XLA compile entirely
+        # (ICT_NO_COMPILE_CACHE=1 opts out).
+        enable_persistent_cache()
     if sweep_pairs is not None:
         from iterative_cleaner_tpu.driver import run_sweep
 

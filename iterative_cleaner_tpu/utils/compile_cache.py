@@ -47,6 +47,43 @@ def inmemory_route_key(shape, cfg, want_residual: bool) -> tuple:
     return (nsub, nchan, nbin, "stepwise", pallas, cfg.x64, incremental, pr)
 
 
+def enable_persistent_cache(path: str | None = None) -> str | None:
+    """Point XLA's persistent compilation cache at a writable directory so
+    *separate processes* skip recompiling identical kernels — a cold CLI
+    run on a shape any earlier run compiled starts in ~the dispatch time
+    instead of the 20-40 s TPU compile, and the hardware playbook's bench
+    runs stop paying the probe run's compiles inside a scarce tunnel
+    window.  (In-process executable reuse is a different mechanism — the
+    jit cache above; this survives the process.)
+
+    Call before the first backend use.  Precedence: ICT_NO_COMPILE_CACHE=1
+    disables; an explicit JAX_COMPILATION_CACHE_DIR (or an explicit
+    ``path``) is used as-is; otherwise ~/.cache/iterative_cleaner_tpu/xla.
+    Best-effort by design — an unwritable directory or an unsupported
+    backend just means compilation stays uncached.  Returns the directory
+    in effect, or None when disabled/failed.
+    """
+    import os
+
+    if os.environ.get("ICT_NO_COMPILE_CACHE") == "1":
+        return None
+    path = path or os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "iterative_cleaner_tpu", "xla")
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Cache every compile: the kernels worth caching here are either
+        # trivially cheap to serialize (CPU) or exactly the 20-40 s TPU
+        # compiles the default 1 s floor would admit anyway — and the
+        # bench/CLI cold numbers should not depend on a heuristic floor.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        return path
+    except Exception:  # noqa: BLE001 — caching is opportunistic
+        return None
+
+
 def already_noted(key: tuple) -> bool:
     """Whether this exact key was noted since the last cache drop — i.e.
     its executables are (or are being) compiled in this process.  The warm

@@ -132,3 +132,60 @@ def test_masks_survive_a_cache_drop(small_archive):
     again = clean_cube(D, w0, cfg)
     assert np.array_equal(ref.weights, again.weights)
     assert ref.loops == again.loops
+
+
+class TestPersistentCache:
+    """enable_persistent_cache: cross-process XLA executable reuse (the
+    CLI default; opt-in for bench so cold numbers stay honestly cold)."""
+
+    @pytest.fixture(autouse=True)
+    def _restore_config(self):
+        import jax
+
+        before = jax.config.jax_compilation_cache_dir
+        before_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        yield
+        jax.config.update("jax_compilation_cache_dir", before)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          before_min)
+
+    def test_opt_out_env_wins(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("ICT_NO_COMPILE_CACHE", "1")
+        assert compile_cache.enable_persistent_cache(str(tmp_path)) is None
+
+    def test_sets_config_and_creates_dir(self, tmp_path, monkeypatch):
+        import jax
+
+        monkeypatch.delenv("ICT_NO_COMPILE_CACHE", raising=False)
+        target = tmp_path / "xla"
+        got = compile_cache.enable_persistent_cache(str(target))
+        assert got == str(target) and target.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(target)
+
+    def test_explicit_env_dir_respected(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("ICT_NO_COMPILE_CACHE", raising=False)
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR",
+                           str(tmp_path / "explicit"))
+        got = compile_cache.enable_persistent_cache()
+        assert got == str(tmp_path / "explicit")
+
+    def test_compiles_populate_the_cache_across_use(self, tmp_path,
+                                                    monkeypatch):
+        """A real compile with the cache enabled must write at least one
+        serialized executable — the property every cross-process reuse
+        claim rests on."""
+        import jax
+        import jax.numpy as jnp
+
+        monkeypatch.delenv("ICT_NO_COMPILE_CACHE", raising=False)
+        target = tmp_path / "xla"
+        assert compile_cache.enable_persistent_cache(str(target))
+        jax.clear_caches()  # force a fresh compile for a unique fn below
+
+        @jax.jit
+        def _probe_kernel(x):
+            return jnp.sum(x * 3.0 + 1.0)
+
+        np.asarray(_probe_kernel(jnp.arange(1024.0)))
+        files = list(target.rglob("*"))
+        assert any(f.is_file() for f in files), files

@@ -29,12 +29,22 @@ while true; do
         pkill -f fuzz_sweep.py 2>/dev/null && note "killed fuzz for timing fidelity"
         pkill -f "pytest tests" 2>/dev/null && note "killed pytest for timing fidelity"
         sleep 2
+        # One fresh per-window persistent compile cache shared by the whole
+        # playbook: the dir starts empty, so the first bench pass is
+        # honestly cold (write-only on first use) while the second pass
+        # reuses every compile instead of paying 20-40s each inside the
+        # scarce window.  bench self-describes cache state in its payload.
+        WINDOW_CACHE="/tmp/ict_window_cache_$$"
+        rm -rf "$WINDOW_CACHE"
         note "probe_template_perf start"
-        timeout 1200 python tools/probe_template_perf.py \
+        JAX_COMPILATION_CACHE_DIR="$WINDOW_CACHE" \
+            timeout 1200 python tools/probe_template_perf.py \
             > docs/probe_${ROUND}_hw.txt 2>&1
         note "probe_template_perf rc=$?"
         note "bench (skip chunked) start"
-        BENCH_SKIP_CHUNKED=1 BENCH_WATCHDOG_S=1500 timeout 1800 \
+        BENCH_SKIP_CHUNKED=1 BENCH_COMPILE_CACHE=1 \
+            JAX_COMPILATION_CACHE_DIR="$WINDOW_CACHE" \
+            BENCH_WATCHDOG_S=1500 timeout 1800 \
             python bench.py > docs/bench_${ROUND}_hw.json 2> docs/bench_${ROUND}_hw.log
         note "bench rc=$?"
         # second pass: chunked section only, if the window survived
@@ -43,6 +53,8 @@ while true; do
             note "window still healthy — chunked pass"
             BENCH_SKIP_NORTHSTAR=1 BENCH_SKIP_PHASES=1 BENCH_SKIP_PALLAS=1 \
                 BENCH_SKIP_STATIC=1 BENCH_MIRROR_TAG=chunked \
+                BENCH_COMPILE_CACHE=1 \
+                JAX_COMPILATION_CACHE_DIR="$WINDOW_CACHE" \
                 BENCH_FULL_NUMPY=0 BENCH_WATCHDOG_S=1500 timeout 1800 \
                 python bench.py > docs/bench_${ROUND}_hw_chunked.json \
                 2> docs/bench_${ROUND}_hw_chunked.log
