@@ -938,26 +938,28 @@ def run_bench() -> dict:
 
 
 def main() -> int:
-    # Persistent XLA compile cache: OPT-IN here, unlike the CLI — the
-    # headline cold numbers must mean a true cold start, not a
-    # cache-warm one.  hw_watch's second (chunked-only) window pass sets
-    # it to reuse the first pass's compiles; the payload self-describes.
-    if os.environ.get("BENCH_COMPILE_CACHE", "0") == "1":
-        from iterative_cleaner_tpu.utils.compile_cache import (
-            enable_persistent_cache,
-        )
-
-        d = enable_persistent_cache()
-        _PAYLOAD["persistent_compile_cache"] = d
-        if d:
-            n = sum(len(files) for _, _, files in os.walk(d))
-            _PAYLOAD["persistent_cache_preexisting_entries"] = n
-            if n:
-                # Entries existed before this run (e.g. the window's probe
-                # or an earlier bench pass): cold timings may hit them.
-                _PAYLOAD["cold_timings_may_be_cache_warm"] = True
     watchdog = _start_watchdog()
     try:
+        # Persistent XLA compile cache: OPT-IN here, unlike the CLI — the
+        # headline cold numbers must mean a true cold start, not a
+        # cache-warm one.  hw_watch's second (chunked-only) window pass
+        # sets it to reuse the first pass's compiles; the payload
+        # self-describes.  Inside the try: every exit path must still
+        # print its JSON line even if this block trips.
+        if os.environ.get("BENCH_COMPILE_CACHE", "0") == "1":
+            from iterative_cleaner_tpu.utils.compile_cache import (
+                enable_persistent_cache,
+            )
+
+            d = enable_persistent_cache()
+            _PAYLOAD["persistent_compile_cache"] = d
+            if d:
+                n = sum(len(files) for _, _, files in os.walk(d))
+                _PAYLOAD["persistent_cache_preexisting_entries"] = n
+                if n:
+                    # Entries existed before this run (an earlier window
+                    # pass): cold timings may hit them.
+                    _PAYLOAD["cold_timings_may_be_cache_warm"] = True
         payload = run_bench()
     except Exception as exc:  # noqa: BLE001 — every exit path emits JSON
         import traceback

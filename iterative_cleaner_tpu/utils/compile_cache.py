@@ -73,12 +73,16 @@ def enable_persistent_cache(path: str | None = None) -> str | None:
         os.makedirs(path, exist_ok=True)
         import jax
 
-        jax.config.update("jax_compilation_cache_dir", path)
         # Cache every compile: the kernels worth caching here are either
         # trivially cheap to serialize (CPU) or exactly the 20-40 s TPU
         # compiles the default 1 s floor would admit anyway — and the
         # bench/CLI cold numbers should not depend on a heuristic floor.
+        # Set the floor BEFORE the dir: the dir update is what activates
+        # caching, so a version-drift failure on either flag leaves the
+        # cache fully off and the None return honest (a dir-then-floor
+        # order could enable caching and then report it disabled).
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_compilation_cache_dir", path)
         return path
     except Exception:  # noqa: BLE001 — caching is opportunistic
         return None

@@ -18,10 +18,20 @@ probe() {
 note() { echo "$(date -u +%H:%M:%S) $*" >> "$LOG"; }
 
 note "watcher started"
+WINDOW=0
 while true; do
     plat="$(probe)"
     if [ "$plat" = "tpu" ]; then
-        note "HEALTHY window open — running playbook"
+        WINDOW=$((WINDOW + 1))
+        # First window writes the canonical artifact names; any later
+        # windows in the same round keep their own suffixed set instead of
+        # overwriting the first capture.
+        if [ "$WINDOW" -gt 1 ]; then
+            TAG="${ROUND}_w${WINDOW}"
+        else
+            TAG="$ROUND"
+        fi
+        note "HEALTHY window open — running playbook (window $WINDOW)"
         # The bench's numpy baseline runs on this 1-core host: any
         # concurrent heavy job (fuzz sweeps, test suites) would inflate it
         # and overstate the speedup.  Kill them; a fuzz batch is rerunnable,
@@ -34,18 +44,21 @@ while true; do
         # honestly cold (write-only on first use) while the second pass
         # reuses every compile instead of paying 20-40s each inside the
         # scarce window.  bench self-describes cache state in its payload.
-        WINDOW_CACHE="/tmp/ict_window_cache_$$"
-        rm -rf "$WINDOW_CACHE"
+        WINDOW_CACHE="/tmp/ict_window_cache_$$_${WINDOW}"
+        rm -rf "$WINDOW_CACHE" "${WINDOW_CACHE}_probe"
         note "probe_template_perf start"
-        JAX_COMPILATION_CACHE_DIR="$WINDOW_CACHE" \
+        # The probe gets its OWN cache dir: sharing would pre-populate the
+        # bench dir and permanently flag (or genuinely warm) the canonical
+        # cold artifact.
+        JAX_COMPILATION_CACHE_DIR="${WINDOW_CACHE}_probe" \
             timeout 1200 python tools/probe_template_perf.py \
-            > docs/probe_${ROUND}_hw.txt 2>&1
+            > docs/probe_${TAG}_hw.txt 2>&1
         note "probe_template_perf rc=$?"
         note "bench (skip chunked) start"
         BENCH_SKIP_CHUNKED=1 BENCH_COMPILE_CACHE=1 \
             JAX_COMPILATION_CACHE_DIR="$WINDOW_CACHE" \
             BENCH_WATCHDOG_S=1500 timeout 1800 \
-            python bench.py > docs/bench_${ROUND}_hw.json 2> docs/bench_${ROUND}_hw.log
+            python bench.py > docs/bench_${TAG}_hw.json 2> docs/bench_${TAG}_hw.log
         note "bench rc=$?"
         # second pass: chunked section only, if the window survived
         plat2="$(probe)"
@@ -56,14 +69,19 @@ while true; do
                 BENCH_COMPILE_CACHE=1 \
                 JAX_COMPILATION_CACHE_DIR="$WINDOW_CACHE" \
                 BENCH_FULL_NUMPY=0 BENCH_WATCHDOG_S=1500 timeout 1800 \
-                python bench.py > docs/bench_${ROUND}_hw_chunked.json \
-                2> docs/bench_${ROUND}_hw_chunked.log
+                python bench.py > docs/bench_${TAG}_hw_chunked.json \
+                2> docs/bench_${TAG}_hw_chunked.log
             note "chunked bench rc=$?"
         else
             note "window closed before chunked pass (plat='$plat2')"
         fi
-        note "playbook done — watcher exiting"
-        exit 0
+        rm -rf "$WINDOW_CACHE" "${WINDOW_CACHE}_probe"
+        note "playbook done for window $WINDOW — resuming watch"
+        # The window is almost certainly spent (the playbook runs ~1h);
+        # cool down before probing again, then keep watching — a later
+        # window in the same round writes its own suffixed artifact set.
+        sleep 600
+        continue
     fi
     note "wedged (probe='$plat'); sleeping 120s"
     sleep 120
