@@ -73,19 +73,43 @@ def enable_persistent_cache(path: str | None = None) -> str | None:
         os.makedirs(path, exist_ok=True)
         import jax
 
+        # Reset FIRST, configure second: jax memoizes "cache unused" at
+        # the first compile of the process (_cache_checked/_cache_used in
+        # jax._src.compilation_cache), so enabling the dir after any
+        # compile would be silently ignored without a reset — and doing
+        # the reset before the config updates means a version-drift
+        # failure at ANY step leaves the cache fully off, keeping the
+        # None return honest (configure-then-reset could enable caching
+        # and then report it disabled).  No compile runs in between, so
+        # the order is otherwise equivalent.
+        if not _reset_cache_state():
+            return None
         # Cache every compile: the kernels worth caching here are either
         # trivially cheap to serialize (CPU) or exactly the 20-40 s TPU
         # compiles the default 1 s floor would admit anyway — and the
         # bench/CLI cold numbers should not depend on a heuristic floor.
-        # Set the floor BEFORE the dir: the dir update is what activates
-        # caching, so a version-drift failure on either flag leaves the
-        # cache fully off and the None return honest (a dir-then-floor
-        # order could enable caching and then report it disabled).
+        # The floor still precedes the dir (the dir update is what
+        # activates caching).
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_compilation_cache_dir", path)
         return path
     except Exception:  # noqa: BLE001 — caching is opportunistic
         return None
+
+
+def _reset_cache_state() -> bool:
+    """Drop jax's memoized persistent-cache object and used-state (the one
+    place that touches the private API); returns False if the private
+    surface drifted.  Shared by enable_persistent_cache and the test
+    teardown that must not leave a stale cache object pointed at a
+    deleted directory."""
+    try:
+        from jax._src.compilation_cache import reset_cache
+
+        reset_cache()
+        return True
+    except Exception:  # noqa: BLE001 — private-API drift tolerated
+        return False
 
 
 def already_noted(key: tuple) -> bool:

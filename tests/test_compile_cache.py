@@ -148,6 +148,9 @@ class TestPersistentCache:
         jax.config.update("jax_compilation_cache_dir", before)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           before_min)
+        # Drop the memoized cache object/used-state too: later suite files
+        # must not keep writing into this test's (deleted) tmp dir.
+        compile_cache._reset_cache_state()
 
     def test_opt_out_env_wins(self, tmp_path, monkeypatch):
         monkeypatch.setenv("ICT_NO_COMPILE_CACHE", "1")
