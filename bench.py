@@ -75,6 +75,27 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _mirror_name(payload: dict) -> str:
+    """Mirror filename for a payload.  Role tags (BENCH_MIRROR_TAG, e.g.
+    hw_watch's chunked-only second pass), runs demoted mid-flight to the
+    CPU fallback (tpu_unreachable — ADVICE r05: the demoted run's payload
+    says "cpu", so without the suffix it would clobber the canonical CPU
+    artifact with reduced-size fallback numbers), and error payloads each
+    get their own filename, so a partial or watchdog emit can never
+    clobber the last COMPLETE same-platform artifact — the exact loss mode
+    this mirror exists to prevent."""
+    plat = str(payload.get("device", "unknown")).split(":", 1)[0]
+    name = f"bench_last_{plat or 'unknown'}"
+    tag = os.environ.get("BENCH_MIRROR_TAG", "")
+    if tag:
+        name += f"_{tag}"
+    if payload.get("tpu_unreachable"):
+        name += "_fallback"
+    if "error" in payload:
+        name += "_error"
+    return name + ".json"
+
+
 def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
     # The driver records only a tail of stdout, and r04's official artifact
@@ -86,19 +107,8 @@ def _emit(payload: dict) -> None:
     if os.environ.get("BENCH_MIRROR", "1") == "0":
         return
     try:
-        plat = str(payload.get("device", "unknown")).split(":", 1)[0]
-        # Role tag (BENCH_MIRROR_TAG, e.g. hw_watch's chunked-only second
-        # pass) and error payloads get their own filenames so a partial or
-        # watchdog emit can never clobber the last COMPLETE same-platform
-        # artifact — the exact loss mode this mirror exists to prevent.
-        name = f"bench_last_{plat or 'unknown'}"
-        tag = os.environ.get("BENCH_MIRROR_TAG", "")
-        if tag:
-            name += f"_{tag}"
-        if "error" in payload:
-            name += "_error"
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "docs", name + ".json")
+                            "docs", _mirror_name(payload))
         with open(path, "w") as f:
             json.dump(payload, f, indent=1)
             f.write("\n")

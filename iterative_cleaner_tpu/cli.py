@@ -12,6 +12,7 @@ console script.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from iterative_cleaner_tpu.config import CleanConfig
@@ -168,6 +169,17 @@ def parse_sweep_pairs(specs: list[str]) -> list[tuple[float, float]]:
 
 
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "serve" and not os.path.isfile("serve"):
+        # The long-running cleaning daemon (docs/SERVING.md).  Dispatched on
+        # the literal first token — unless a regular FILE named "serve"
+        # exists in cwd (a directory can never be an archive positional),
+        # in which case the reference semantics win; the ``ict-serve``
+        # script is the unambiguous entry point.
+        from iterative_cleaner_tpu.service.daemon import serve_main
+
+        return serve_main(argv[1:])
     args = build_parser().parse_args(argv)
     try:
         cfg = config_from_args(args)
@@ -180,7 +192,7 @@ def main(argv: list[str] | None = None) -> int:
         # forever; probe killably and demote to CPU loudly instead
         # (utils/device_probe.py — no-op when already pinned to CPU).
         from iterative_cleaner_tpu.utils.compile_cache import (
-            enable_persistent_cache,
+            enable_and_trim_persistent_cache,
         )
         from iterative_cleaner_tpu.utils.device_probe import (
             ensure_responsive_backend,
@@ -189,8 +201,9 @@ def main(argv: list[str] | None = None) -> int:
         ensure_responsive_backend()
         # Cross-process executable reuse: a repeat clean of any
         # previously-seen shape skips its cold XLA compile entirely
-        # (ICT_NO_COMPILE_CACHE=1 opts out).
-        enable_persistent_cache()
+        # (ICT_NO_COMPILE_CACHE=1 opts out).  The trim keeps the on-disk
+        # cache size-bounded (ICT_COMPILE_CACHE_MAX_MB; ADVICE r05).
+        enable_and_trim_persistent_cache()
     if sweep_pairs is not None:
         from iterative_cleaner_tpu.driver import run_sweep
 

@@ -249,6 +249,63 @@ def process_archive(
     )
 
 
+# Fraction of host RAM the all-at-once batch loader may plausibly fill
+# before the driver flips to the streaming dispatcher by itself (VERDICT
+# r05 item 5).  The estimate is the batch's on-disk size — compressed NPZ
+# underestimates the decoded cubes, so the fraction is conservative.
+STREAM_RAM_FRACTION = 0.25
+
+
+def _host_ram_bytes() -> int:
+    try:
+        return os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+    except (ValueError, OSError):
+        return 0
+
+
+def _stream_threshold_bytes() -> int:
+    """On-disk batch size above which --sharded_batch streams by default;
+    0 disables the auto-flip.  ICT_STREAM_THRESHOLD_BYTES overrides (tests
+    and hosts where sysconf lies)."""
+    env = os.environ.get("ICT_STREAM_THRESHOLD_BYTES")
+    if env is not None:
+        try:
+            return int(float(env))
+        except ValueError:
+            print(f"warning: ignoring unparseable ICT_STREAM_THRESHOLD_BYTES"
+                  f"={env!r} (want a byte count); using the host-RAM default",
+                  file=sys.stderr)
+    return int(_host_ram_bytes() * STREAM_RAM_FRACTION)
+
+
+def _auto_stream(paths: list[str], cfg: CleanConfig) -> bool:
+    """Whether this batch should take the streaming route even without
+    --stream: the all-at-once loader holds every decoded cube on host
+    during bucketing, which an above-RAM-threshold directory cannot
+    afford (masks are identical either way; only emission order and host
+    residency differ)."""
+    if cfg.stream:
+        return True
+    threshold = _stream_threshold_bytes()
+    if threshold <= 0:
+        return False
+    total = 0
+    for p in paths:
+        try:
+            total += os.path.getsize(p)
+        except OSError:
+            continue  # missing files fail per-archive later, as always
+    if total > threshold:
+        if not cfg.quiet:
+            print(
+                f"note: batch on-disk size ({total / 1e9:.1f} GB) exceeds "
+                f"the host-memory threshold ({threshold / 1e9:.1f} GB); "
+                "using the streaming dispatcher (bounded host residency — "
+                "pass --stream to silence this note)", file=sys.stderr)
+        return True
+    return False
+
+
 def run_sharded_batch(
     paths: list[str],
     cfg: CleanConfig,
@@ -313,7 +370,7 @@ def run_sharded_batch(
             path=item.path, out_path=None, error=item.error)
 
     with profile_trace(cfg.trace_dir):
-        if cfg.stream:
+        if _auto_stream(paths, cfg):
             items = clean_directory_streaming(
                 paths, cfg, mesh=mesh, on_item=emit_item)
         else:

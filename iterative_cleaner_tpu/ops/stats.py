@@ -214,11 +214,20 @@ def _fft_diag_instance(ndim: int):
     inst = _fft_diag_instances.get(ndim)
     if inst is not None:
         return inst
-    from jax.experimental.custom_partitioning import (
-        SdyShardingRule,
-        custom_partitioning,
-    )
+    from jax.experimental.custom_partitioning import custom_partitioning
     from jax.sharding import NamedSharding, PartitionSpec
+
+    try:
+        # jax >= 0.5: Shardy (the eventual default partitioner) reads a
+        # sharding rule instead of the GSPMD callbacks.  Older jax within
+        # the declared >=0.4.30 floor has neither the class nor the
+        # ``sharding_rule=`` kwarg, so fall back to callbacks-only — GSPMD
+        # is the only partitioner there, and the callbacks are authoritative
+        # (ADVICE r05: the unconditional import broke every fft_diagnostic
+        # call on older jax, sharded or not).
+        from jax.experimental.custom_partitioning import SdyShardingRule
+    except ImportError:
+        SdyShardingRule = None
 
     def _supported(sharding, aval):
         """The operand sharding we can execute locally: leading dims as the
@@ -244,14 +253,17 @@ def _fft_diag_instance(ndim: int):
         return _shardings(arg_shapes)[1]
 
     inst = custom_partitioning(_fft_diag_impl)
-    dims = tuple(string.ascii_lowercase[:ndim])
-    inst.def_partition(
-        partition=_partition,
-        infer_sharding_from_operands=_infer,
+    kw = {}
+    if SdyShardingRule is not None:
         # Shardy (the jax>=0.9 default partitioner) reads this rule instead
         # of the GSPMD callbacks: every leading dim propagates, bins stay
         # whole.
-        sharding_rule=SdyShardingRule((dims,), (dims[:-1],)),
+        dims = tuple(string.ascii_lowercase[:ndim])
+        kw["sharding_rule"] = SdyShardingRule((dims,), (dims[:-1],))
+    inst.def_partition(
+        partition=_partition,
+        infer_sharding_from_operands=_infer,
+        **kw,
     )
     _fft_diag_instances[ndim] = inst
     return inst

@@ -24,7 +24,10 @@ from iterative_cleaner_tpu.io.base import Archive, get_io
 from iterative_cleaner_tpu.ops.preprocess import preprocess
 from iterative_cleaner_tpu.parallel.mesh import make_mesh
 from iterative_cleaner_tpu.parallel.sharded import sharded_clean
-from iterative_cleaner_tpu.utils.compile_cache import note_compiled_shape
+from iterative_cleaner_tpu.utils.compile_cache import (
+    batch_route_key,
+    note_compiled_shape,
+)
 
 
 @dataclass
@@ -52,27 +55,33 @@ def _require_jax_backend(cfg: CleanConfig) -> None:
             "backend='jax'; use driver.run() for the sequential numpy path")
 
 
+def finalize_weights(final_w, cfg) -> tuple[np.ndarray, float]:
+    """One archive's post-clean finalization policy, in ONE place (shared
+    by the bucket dispatcher, the service's oracle fallback, and the serve
+    smoke check, so the three can never drift): rfi_frac reports the
+    iterative mask BEFORE the bad-parts sweep — identical to the
+    sequential driver's ArchiveReport.rfi_frac — and the sweep runs only
+    when a flag differs from 1.  Returns (final_weights, rfi_frac)."""
+    rfi_frac = float((final_w == 0).mean())
+    if cfg.bad_chan != 1 or cfg.bad_subint != 1:
+        final_w, _ns, _nc = find_bad_parts(final_w, cfg)
+    return final_w, rfi_frac
+
+
 def _finish_bucket(items, idxs, Db, w0b, cfg, mesh, on_item=None) -> None:
     """Run one stacked bucket on the mesh and write results into its
     BatchItems (shared by the all-at-once and streaming dispatchers).
     ``on_item(i, item)`` fires per finished archive — the streaming driver
     emits outputs there and releases the item's host arrays, which is what
     makes its memory bound real."""
-    # Mirror batched_fused_clean's static-arg surface (max_iter,
-    # pulse_region).  No x64 axis: the batch route has no x64 handling
-    # (preprocess emits f32 and the sharded kernel never casts), so both
-    # cfg.x64 values reuse one executable.
-    note_compiled_shape((*Db.shape, "batch", cfg.max_iter,
-                         tuple(cfg.pulse_region)))
+    # The key mirrors batched_fused_clean's static-arg surface; shared with
+    # the service warm pool so a pool-warmed batch shape is recognised here
+    # (see compile_cache.batch_route_key for the x64 note).
+    note_compiled_shape(batch_route_key(Db.shape, cfg))
     test_b, w_b, loops_b, done_b = sharded_clean(Db, w0b, cfg, mesh)
     for j, i in enumerate(idxs):
         item = items[i]
-        final_w = w_b[j]
-        # rfi_frac reports the iterative mask, pre-bad-parts sweep —
-        # identical to the sequential driver's ArchiveReport.rfi_frac.
-        item.rfi_frac = float((final_w == 0).mean())
-        if cfg.bad_chan != 1 or cfg.bad_subint != 1:
-            final_w, _ns, _nc = find_bad_parts(final_w, cfg)
+        final_w, item.rfi_frac = finalize_weights(w_b[j], cfg)
         item.weights = final_w
         item.test_results = test_b[j]
         item.loops = int(loops_b[j])

@@ -1,0 +1,23 @@
+"""ict-serve: the long-running cleaning service.
+
+Every other entry point (CLI, driver.run, the directory batchers) is
+one-shot — load, clean, exit — paying cold compiles and device setup per
+invocation.  Real RFI-mitigation deployments are continuous pipelines
+(cf. arXiv:1701.08197), so this subsystem keeps one process alive:
+
+- :mod:`.jobs`      — job records + on-disk spool (restart-safe manifest)
+- :mod:`.scheduler` — shape-bucketed admission queue (dp-slice / deadline)
+- :mod:`.worker`    — fault-isolated dispatch (retry, oracle fallback)
+- :mod:`.pool`      — warm executable pool (startup precompile)
+- :mod:`.api`       — stdlib-HTTP JSON endpoints (/jobs, /healthz, /metrics)
+- :mod:`.daemon`    — lifecycle + the ``ict-serve`` CLI
+
+The service is routing, not math: masks stay bit-identical to the numpy
+oracle on every served route (the sharded bucket dispatch is pinned by
+tests/test_parallel.py; the degraded route IS the oracle).
+"""
+
+from iterative_cleaner_tpu.service.jobs import Job, JobSpool
+from iterative_cleaner_tpu.service.daemon import CleaningService, ServeConfig
+
+__all__ = ["Job", "JobSpool", "CleaningService", "ServeConfig"]

@@ -1,0 +1,105 @@
+"""JSON HTTP surface over stdlib ``http.server`` — zero new dependencies.
+
+Endpoints (all JSON; full reference in docs/SERVING.md):
+
+- ``POST /jobs``            ``{"path": "/abs/archive.npz"}`` -> 202 + job
+- ``GET  /jobs/<id>``       job manifest (state machine in service/jobs.py)
+- ``GET  /healthz``         liveness + backend mode + queue depths
+- ``GET  /metrics``         the process-global per-phase counters
+                            (utils/tracing.py: ``*_s`` total seconds,
+                            ``*_n`` counts, ``service_*`` events)
+
+ThreadingHTTPServer: each request gets a thread, so a slow client cannot
+stall the poll loop; all handlers only touch thread-safe service surfaces
+(spool writes are serialized, counters are locked, submission enqueues).
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from iterative_cleaner_tpu.utils import tracing
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Bound every socket read (BaseRequestHandler.setup applies this via
+    # connection.settimeout): a client that under-sends its declared body
+    # or never sends a request line must time out, not leak this handler
+    # thread and its FD forever.
+    timeout = 30
+
+    # The default handler logs every request line to stderr; route through
+    # the service's quiet flag instead (a health-checked daemon would spam).
+    def log_message(self, fmt, *args):  # noqa: A003 — stdlib signature
+        if not self.server.service.serve_cfg.quiet:
+            BaseHTTPRequestHandler.log_message(self, fmt, *args)
+
+    def _reply(self, code: int, payload: dict, headers: dict | None = None) -> None:
+        body = (json.dumps(payload) + "\n").encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 — stdlib signature
+        service = self.server.service
+        if self.path == "/healthz":
+            self._reply(200, service.health())
+        elif self.path == "/metrics":
+            self._reply(200, tracing.counters_snapshot())
+        elif self.path.startswith("/jobs/"):
+            job = service.job(self.path[len("/jobs/"):])
+            if job is None:
+                self._reply(404, {"error": "no such job"})
+            else:
+                self._reply(200, job.to_dict())
+        else:
+            self._reply(404, {"error": f"no such route {self.path!r}"})
+
+    def do_POST(self) -> None:  # noqa: N802 — stdlib signature
+        service = self.server.service
+        if self.path != "/jobs":
+            self._reply(404, {"error": f"no such route {self.path!r}"})
+            return
+        try:
+            # Clamp the client-supplied length: a negative value would make
+            # read() block until EOF (leaking this handler thread) and a
+            # huge one would buffer it all; job bodies are tiny.
+            n = max(0, min(int(self.headers.get("Content-Length", 0)),
+                           1 << 20))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            path = body["path"]
+        # TypeError covers valid-JSON non-dict bodies ('[]', '5', 'null'):
+        # the client gets a 400, not a dropped socket.
+        except (ValueError, KeyError, TypeError) as exc:
+            self._reply(400, {"error": f"bad request body: {exc!r}; expected "
+                                       '{"path": "/abs/archive"}'})
+            return
+        from iterative_cleaner_tpu.service.daemon import ServiceBusy
+
+        try:
+            job = service.submit(str(path))
+        except ServiceBusy as exc:
+            self._reply(503, {"error": str(exc)}, headers={"Retry-After": "5"})
+            return
+        except ValueError as exc:   # --root refusal
+            self._reply(400, {"error": str(exc)})
+            return
+        except Exception as exc:  # noqa: BLE001 — e.g. a spool write failure:
+            # the client deserves a 500, not a dropped socket
+            self._reply(500, {"error": f"submission failed: {exc}"})
+            return
+        self._reply(202, job.to_dict())
+
+
+def make_http_server(service, host: str, port: int) -> ThreadingHTTPServer:
+    """Bind (port 0 -> ephemeral, for tests); caller runs serve_forever on
+    a thread and shutdown() on stop."""
+    server = ThreadingHTTPServer((host, port), _Handler)
+    server.daemon_threads = True
+    server.service = service
+    return server

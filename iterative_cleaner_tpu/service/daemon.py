@@ -1,0 +1,574 @@
+"""Daemon lifecycle + the ``ict-serve`` CLI.
+
+Thread layout (all daemonic; ``stop()`` is graceful):
+
+- N loader threads: decode + preprocess submitted archives (host-side,
+  independent per file — the parallel/batch thread-pool idiom) and offer
+  the cubes to the shape-bucketed scheduler;
+- 1 tick thread: fires the scheduler's deadline flushes;
+- 1 dispatch worker: runs flushed buckets on the mesh (service/worker.py);
+- the ThreadingHTTPServer's per-request threads (service/api.py).
+
+Jobs the daemon accepted but had not finished when it died stay in the
+on-disk spool as ``pending``/``running`` manifests; the next start replays
+them (service/jobs.py), so a restart loses no accepted work.
+
+``python -m iterative_cleaner_tpu serve --smoke`` runs the whole stack
+against one synthetic archive over real HTTP and verifies the returned
+mask bit-identical to the numpy oracle — the offline health check CI and
+operators share.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import queue
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.service.jobs import TERMINAL, Job, JobSpool
+from iterative_cleaner_tpu.service.scheduler import ShapeBucketScheduler
+from iterative_cleaner_tpu.service.worker import DispatchWorker
+from iterative_cleaner_tpu.utils import tracing
+
+_STOP = object()
+
+
+class ServiceBusy(RuntimeError):
+    """Admission refused: the open-job cap is reached (the API maps this to
+    503 + Retry-After).  The cap is the daemon's backpressure — every open
+    job can hold one decoded f32 cube on host, so unbounded admission would
+    let a submission burst outrun the single dispatch thread and OOM."""
+
+
+@dataclass
+class ServeConfig:
+    spool_dir: str = "./ict_serve_spool"
+    host: str = "127.0.0.1"
+    port: int = 8750                 # 0 = ephemeral (tests)
+    bucket_cap: int = 0              # 0 = the mesh's dp extent
+    deadline_s: float = 2.0          # max wait before a partial bucket flushes
+    loaders: int = 2
+    warm_shapes: tuple = ()          # (nsub, nchan, nbin) classes to precompile
+    dispatch_retries: int = 2
+    retry_backoff_s: float = 0.25
+    demote_after: int = 2            # consecutive bucket failures -> oracle mode
+    spool_keep: int = 10000          # terminal manifests kept as job history
+    max_open_jobs: int = 64          # admission cap (0 = unbounded): bounds
+                                     # decoded-cube host residency; size it
+                                     # to host RAM / cube size
+    root: str = ""                   # when set, submitted paths must resolve
+                                     # under this directory (the non-loopback
+                                     # trust boundary)
+    quiet: bool = False
+    clean: CleanConfig = field(
+        default_factory=lambda: CleanConfig(backend="jax"))
+
+
+class CleaningService:
+    """The persistent cleaning daemon; see the module docstring for the
+    thread layout and docs/SERVING.md for the operator contract."""
+
+    def __init__(self, serve_cfg: ServeConfig, mesh=None) -> None:
+        self.serve_cfg = serve_cfg
+        self.clean_cfg = serve_cfg.clean
+        self.spool = JobSpool(serve_cfg.spool_dir)
+        self.mesh = mesh
+        self.backend_mode = self.clean_cfg.backend   # "jax" | "numpy"
+        self.bucket_cap = 1
+        self.port = serve_cfg.port
+        self.pool = None
+        self._jobs: dict[str, Job] = {}
+        self._jobs_lock = threading.Lock()
+        self._load_q: queue.Queue = queue.Queue()
+        self._consecutive_failures = 0
+        self._threads: list[threading.Thread] = []
+        self._stop_evt = threading.Event()
+        self._server = None
+        self.scheduler = None
+        self.worker = None
+
+    # --- lifecycle ---
+
+    def start(self) -> None:
+        # Single-daemon guard FIRST: a second daemon on the same spool
+        # would sweep this one's atomic-write temps and re-dispatch its
+        # running jobs before even failing to bind the port.
+        self.spool.acquire_exclusive()
+        try:
+            self._start_locked()
+        except BaseException:
+            # A mid-start failure (e.g. EADDRINUSE at the HTTP bind, after
+            # warmup and spool replay) must not leak the flock or the
+            # already-started threads — a corrected retry on the same
+            # spool would otherwise see "already served" from a dead
+            # service object.
+            try:
+                self.stop()
+            except Exception:  # noqa: BLE001 — surface the original error
+                pass
+            raise
+
+    def _start_locked(self) -> None:
+        if self.backend_mode == "jax":
+            # The CLI front-door wedge guard (utils/device_probe.py): a hung
+            # probe with indeterminable liveness means the next jax call may
+            # hang the daemon — that, and only that, degrades the whole
+            # service to the numpy oracle.  A plain "demoted" keeps the jax
+            # route: it is pinned to CPU, masks identical, wall-clock not.
+            from iterative_cleaner_tpu.utils.device_probe import (
+                ensure_responsive_backend,
+            )
+
+            if ensure_responsive_backend() == "demote_failed":
+                print("ict-serve: backend liveness indeterminable after a "
+                      "hung probe; serving via the numpy oracle",
+                      file=sys.stderr)
+                self.backend_mode = "numpy"
+        cap = 1
+        if self.backend_mode == "jax":
+            if self.mesh is None:
+                from iterative_cleaner_tpu.parallel.mesh import make_mesh
+
+                self.mesh = make_mesh()
+            cap = self.serve_cfg.bucket_cap or max(int(self.mesh.shape["dp"]), 1)
+        self.scheduler = ShapeBucketScheduler(
+            cap, self.serve_cfg.deadline_s, self._on_flush)
+        # The pow2 clamp lives in the scheduler (the mechanism that owns
+        # the invariant); the warm pool reads the clamped value so the
+        # precompiled batch-size set matches the sizes actually emitted.
+        self.bucket_cap = self.scheduler.bucket_cap
+        if self.backend_mode == "jax":
+            from iterative_cleaner_tpu.service.pool import WarmPool
+
+            self.pool = WarmPool(self.clean_cfg, self.mesh, self.bucket_cap,
+                                 quiet=self.serve_cfg.quiet)
+            self.pool.warm_startup(self.serve_cfg.warm_shapes)
+        self.worker = DispatchWorker(self)
+        # Spool trim + replay run BEFORE any thread starts: the trim's
+        # .json.part sweep is only safe while no writer thread exists (the
+        # invariant jobs.trim documents), and the worker object's _fail
+        # needs no running thread.  One directory scan feeds both halves —
+        # with a 10k-manifest history, scanning twice would double the
+        # pre-API startup I/O.  Replayed jobs just queue; the loaders
+        # drain them once started below.
+        spooled = self.spool.all_jobs()
+        self.spool.trim(self.serve_cfg.spool_keep, jobs=spooled)
+        # Recovered jobs keep their original (older, time-sortable) ids,
+        # so they drain ahead of new traffic of the same shape.
+        for job in self.spool.recover(jobs=spooled):
+            with self._jobs_lock:
+                self._jobs[job.id] = job
+            try:
+                # Replayed manifests are re-validated against the CURRENT
+                # --root (the boundary may have changed across restarts,
+                # and old manifests predate it).
+                job.path = self._check_root(job.path)
+            except ValueError as exc:
+                self.worker._fail(job, str(exc))
+                continue
+            self._load_q.put(job)
+            tracing.count("service_jobs_recovered")
+        self.worker.start()
+        self._threads.append(self.worker)
+        for i in range(max(self.serve_cfg.loaders, 1)):
+            th = threading.Thread(target=self._load_loop, daemon=True,
+                                  name=f"ict-serve-load-{i}")
+            th.start()
+            self._threads.append(th)
+        th = threading.Thread(target=self._tick_loop, daemon=True,
+                              name="ict-serve-tick")
+        th.start()
+        self._threads.append(th)
+        from iterative_cleaner_tpu.service.api import make_http_server
+
+        self._server = make_http_server(
+            self, self.serve_cfg.host, self.serve_cfg.port)
+        self.port = self._server.server_address[1]
+        th = threading.Thread(target=self._server.serve_forever, daemon=True,
+                              name="ict-serve-http")
+        th.start()
+        self._threads.append(th)
+        if not self.serve_cfg.quiet:
+            print(f"ict-serve: listening on "
+                  f"http://{self.serve_cfg.host}:{self.port} "
+                  f"(backend={self.backend_mode}, bucket_cap="
+                  f"{self.bucket_cap}, spool={self.spool.root})",
+                  file=sys.stderr)
+
+    def stop(self) -> None:
+        """Graceful stop: the API closes, threads drain their queues' poison
+        pills, and any still-unfinished job stays in the spool for the next
+        life (restart-resume is the durability story, not a shutdown barrier)."""
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+        self._stop_evt.set()
+        for _ in range(max(self.serve_cfg.loaders, 1)):
+            self._load_q.put(_STOP)
+        if self.worker is not None:
+            self.worker.stop()
+        stuck = []
+        for th in self._threads:
+            th.join(timeout=10)
+            if th.is_alive():
+                stuck.append(th.name)
+        if stuck:
+            # A live thread may still be WRITING spool manifests; releasing
+            # the flock would let a successor daemon's .part sweep and
+            # running-job replay race it (the exclusivity trim() depends
+            # on).  Keep the lock — the kernel frees it at process exit.
+            print(f"ict-serve: threads still running after stop "
+                  f"({', '.join(stuck)}); keeping the spool lock until "
+                  "process exit", file=sys.stderr)
+        else:
+            self.spool.release_exclusive()
+
+    # --- submission / inspection (the API's surface) ---
+
+    def submit(self, path: str) -> Job:
+        path = self._check_root(path)
+        from iterative_cleaner_tpu.service.jobs import new_job_id
+
+        job = Job(id=new_job_id(), path=path, submitted_s=time.time())
+        # Cap check and insert under ONE lock hold: concurrent POST handler
+        # threads must not all pass the check before any of them inserts
+        # (the cap is the OOM backpressure — a race would breach it).
+        with self._jobs_lock:
+            if self.serve_cfg.max_open_jobs:
+                # retire() evicts terminal jobs, so this scan is O(open).
+                open_n = sum(1 for j in self._jobs.values()
+                             if j.state not in TERMINAL)
+                if open_n >= self.serve_cfg.max_open_jobs:
+                    tracing.count("service_jobs_refused")
+                    raise ServiceBusy(
+                        f"{open_n} open jobs at the --max_open_jobs cap "
+                        f"({self.serve_cfg.max_open_jobs}); retry later")
+            self._jobs[job.id] = job
+        try:
+            self.spool.save(job)
+        except Exception:
+            # Roll the admission back: a job that was never made durable is
+            # also never enqueued, so leaving it in _jobs would leak one
+            # max_open_jobs slot per failed save until restart.
+            with self._jobs_lock:
+                self._jobs.pop(job.id, None)
+            raise
+        tracing.count("service_jobs_submitted")
+        self._load_q.put(job)
+        return job
+
+    def job(self, job_id: str) -> Job | None:
+        with self._jobs_lock:
+            job = self._jobs.get(job_id)
+        return job if job is not None else self.spool.get(job_id)
+
+    def _check_root(self, path: str) -> str:
+        """Validate ``path`` against --root and return its RESOLVED real
+        path.  The resolved path is what gets stored and later opened, so
+        a symlink retargeted between admission and load (or before a
+        restart replay) cannot redirect the read outside the boundary —
+        the check and the use see the same target."""
+        root = self.serve_cfg.root
+        if not root:
+            return path
+        real = os.path.realpath(path)
+        real_root = os.path.realpath(root)
+        try:
+            # commonpath, not startswith: '--root /' must mean "any
+            # absolute path", and '/data' must not admit '/database'.
+            inside = os.path.commonpath([real, real_root]) == real_root
+        except ValueError:   # e.g. a relative submission path
+            inside = False
+        if not inside:
+            raise ValueError(f"path {path!r} is outside --root {root!r}")
+        return real
+
+    def retire(self, job: Job) -> None:
+        """Drop a terminal job from the in-memory index — the spool manifest
+        is the durable record (job() falls back to it), so a continuous-
+        traffic daemon's memory stays bounded by OPEN work, not by every
+        job it ever served."""
+        with self._jobs_lock:
+            self._jobs.pop(job.id, None)
+
+    def health(self) -> dict:
+        with self._jobs_lock:
+            open_jobs = sum(1 for j in self._jobs.values()
+                            if j.state not in TERMINAL)
+        return {
+            "status": "ok",
+            "backend": self.backend_mode,
+            "open_jobs": open_jobs,
+            "bucketed_cubes": (self.scheduler.pending_count()
+                               if self.scheduler else 0),
+            "bucket_cap": self.bucket_cap,
+            "deadline_s": self.serve_cfg.deadline_s,
+            "warm_shapes": (self.pool.warm_shapes_now() if self.pool else []),
+            "spool": self.spool.root,
+        }
+
+    def drain(self, timeout_s: float = 120.0) -> bool:
+        """Block until every accepted job is terminal (tests + shutdown
+        hooks); True on success, False on timeout."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            with self._jobs_lock:
+                if all(j.state in TERMINAL for j in self._jobs.values()):
+                    return True
+            time.sleep(0.02)
+        return False
+
+    # --- internals ---
+
+    def _load_loop(self) -> None:
+        from iterative_cleaner_tpu.parallel.batch import _load_and_preprocess
+
+        while True:
+            job = self._load_q.get()
+            if job is _STOP:
+                return
+            try:
+                with tracing.phase("service_load"):
+                    archive, D, w0 = _load_and_preprocess(job.path)
+            except Exception as exc:  # noqa: BLE001 — a poisoned archive
+                # fails ALONE, before it can join (and take down) a bucket.
+                self.worker._fail(job, f"load failed: {exc}")
+                continue
+            self.scheduler.offer(job, archive, D, w0)
+
+    def _tick_loop(self) -> None:
+        interval = min(max(self.serve_cfg.deadline_s / 4, 0.01), 0.25)
+        while not self._stop_evt.wait(interval):
+            self.scheduler.tick()
+
+    def _on_flush(self, entries) -> None:
+        tracing.count("service_buckets_dispatched")
+        self.worker.submit(entries)
+
+    def note_dispatch_ok(self) -> None:
+        self._consecutive_failures = 0
+
+    def note_dispatch_failure(self, exc) -> None:
+        self._consecutive_failures += 1
+        if (self.backend_mode == "jax"
+                and self._consecutive_failures >= self.serve_cfg.demote_after):
+            self.backend_mode = "numpy"
+            tracing.count("service_backend_demotions")
+            print(f"ict-serve: {self._consecutive_failures} consecutive "
+                  f"bucket dispatches failed (last: {exc}); demoting the "
+                  "service to the numpy oracle backend", file=sys.stderr)
+
+
+# --- CLI ---
+
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ict-serve",
+        description="Long-running cleaning daemon: shape-bucketed admission, "
+                    "warm executable pool, fault-isolated job execution "
+                    "(docs/SERVING.md)")
+    p.add_argument("--spool", default="./ict_serve_spool",
+                   help="job-manifest directory; a restarted daemon resumes "
+                        "the pending jobs found here (default: "
+                        "./ict_serve_spool)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8750,
+                   help="HTTP port (0 = ephemeral; default 8750)")
+    p.add_argument("--bucket_cap", type=int, default=0, metavar="N",
+                   help="archives per sharded dispatch (0 = the mesh's "
+                        "data-parallel extent; clamped to a power of two)")
+    p.add_argument("--deadline_s", type=float, default=2.0, metavar="S",
+                   help="max seconds a partial bucket waits before it is "
+                        "dispatched anyway (default 2.0)")
+    p.add_argument("--loaders", type=int, default=2,
+                   help="archive-decode threads (default 2)")
+    p.add_argument("--spool_keep", type=int, default=10000, metavar="N",
+                   help="finished-job manifests kept as history; older ones "
+                        "are pruned at startup (default 10000)")
+    p.add_argument("--max_open_jobs", type=int, default=64, metavar="N",
+                   help="admission cap: submissions beyond N open jobs get "
+                        "503 (backpressure — every open job can hold one "
+                        "decoded cube on host; 0 = unbounded; default 64)")
+    p.add_argument("--root", default="", metavar="DIR",
+                   help="only accept archive paths under DIR (REQUIRED "
+                        "hardening for non-loopback --host: without it any "
+                        "reachable client can make the daemon read any file "
+                        "and write a _cleaned output next to it)")
+    p.add_argument("--warm", action="append", default=[],
+                   metavar="NSUBxNCHANxNBIN",
+                   help="shape class to precompile at startup (repeatable), "
+                        "e.g. --warm 256x1024x1024")
+    p.add_argument("--backend", choices=("numpy", "jax"), default="jax")
+    p.add_argument("-c", "--chanthresh", type=float, default=5)
+    p.add_argument("-s", "--subintthresh", type=float, default=5)
+    p.add_argument("-m", "--max_iter", type=int, default=5)
+    p.add_argument("--bad_chan", type=float, default=1)
+    p.add_argument("--bad_subint", type=float, default=1)
+    p.add_argument("-q", "--quiet", action="store_true")
+    p.add_argument("--smoke", action="store_true",
+                   help="offline self-check: start the daemon, clean one "
+                        "synthetic archive through the HTTP API, verify the "
+                        "mask against the numpy oracle, print one JSON line, "
+                        "exit")
+    return p
+
+
+def parse_warm_shapes(specs: list[str]) -> tuple:
+    shapes = []
+    for spec in specs:
+        try:
+            nsub, nchan, nbin = (int(v) for v in spec.lower().split("x"))
+            shapes.append((nsub, nchan, nbin))
+        except ValueError:
+            raise ValueError(
+                f"bad --warm shape {spec!r}; expected NSUBxNCHANxNBIN "
+                "like 256x1024x1024") from None
+    return tuple(shapes)
+
+
+def serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
+    # Reject ambiguous negatives up front (serve_main turns the ValueError
+    # into the one-line error + rc 2 contract): -1 is NOT "unbounded" —
+    # it would make the cap check refuse every submission forever.
+    if args.max_open_jobs < 0:
+        raise ValueError(f"--max_open_jobs must be >= 0 (0 = unbounded), "
+                         f"got {args.max_open_jobs}")
+    if args.bucket_cap < 0:
+        raise ValueError(f"--bucket_cap must be >= 0 (0 = the mesh's dp "
+                         f"extent), got {args.bucket_cap}")
+    return ServeConfig(
+        spool_dir=args.spool,
+        host=args.host,
+        port=args.port,
+        bucket_cap=args.bucket_cap,
+        deadline_s=args.deadline_s,
+        loaders=args.loaders,
+        spool_keep=args.spool_keep,
+        max_open_jobs=args.max_open_jobs,
+        root=args.root,
+        warm_shapes=parse_warm_shapes(args.warm),
+        quiet=args.quiet,
+        clean=CleanConfig(
+            backend=args.backend,
+            chanthresh=args.chanthresh,
+            subintthresh=args.subintthresh,
+            max_iter=args.max_iter,
+            bad_chan=args.bad_chan,
+            bad_subint=args.bad_subint,
+            quiet=args.quiet,
+        ),
+    )
+
+
+def run_smoke(serve_cfg: ServeConfig) -> int:
+    import json
+    import tempfile
+    import urllib.request
+
+    import numpy as np
+
+    from iterative_cleaner_tpu.core.cleaner import clean_cube
+    from iterative_cleaner_tpu.io.npz import NpzIO
+    from iterative_cleaner_tpu.io.synthetic import make_archive
+    from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+    with tempfile.TemporaryDirectory(prefix="ict_serve_smoke_") as tmp:
+        path = os.path.join(tmp, "smoke.npz")
+        archive = make_archive(nsub=4, nchan=16, nbin=64, seed=99)
+        NpzIO().save(archive, path)
+        # Hermetic overrides: the smoke archive lives in this tempdir, so
+        # an operator --root (or a tiny cap) must not refuse the probe.
+        cfg = ServeConfig(**{**serve_cfg.__dict__,
+                             "spool_dir": os.path.join(tmp, "spool"),
+                             "port": 0, "deadline_s": 0.2,
+                             "root": "", "max_open_jobs": 0})
+        service = CleaningService(cfg)
+        service.start()
+        try:
+            base = f"http://{cfg.host}:{service.port}"
+            req = urllib.request.Request(
+                f"{base}/jobs", data=json.dumps({"path": path}).encode(),
+                headers={"Content-Type": "application/json"})
+            job = json.load(urllib.request.urlopen(req, timeout=30))
+            deadline = time.time() + 300
+            while job["state"] not in TERMINAL and time.time() < deadline:
+                time.sleep(0.1)
+                job = json.load(urllib.request.urlopen(
+                    f"{base}/jobs/{job['id']}", timeout=30))
+            health = json.load(urllib.request.urlopen(
+                f"{base}/healthz", timeout=30))
+            ok = job["state"] == "done" and health.get("status") == "ok"
+            masks_ok = False
+            if ok:
+                from iterative_cleaner_tpu.parallel.batch import (
+                    finalize_weights,
+                )
+
+                cfg_np = cfg.clean.replace(backend="numpy")
+                # Same finalization as every served route (shared helper):
+                # the oracle comparison includes the bad-parts sweep.
+                want, _rfi = finalize_weights(
+                    clean_cube(*preprocess(archive), cfg_np).weights, cfg_np)
+                got = NpzIO().load(job["out_path"])
+                masks_ok = bool(np.array_equal(got.weights, want))
+            print(json.dumps({
+                "smoke": "ok" if ok and masks_ok else "FAIL",
+                "job_state": job["state"],
+                "served_by": job.get("served_by", ""),
+                "mask_identical_to_oracle": masks_ok,
+                "backend": health.get("backend"),
+            }))
+            return 0 if ok and masks_ok else 1
+        finally:
+            service.stop()
+
+
+def serve_main(argv: list[str] | None = None) -> int:
+    args = build_serve_parser().parse_args(argv)
+    try:
+        serve_cfg = serve_config_from_args(args)
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    if serve_cfg.clean.backend == "jax":
+        # Same CLI-layer policy as cli.main (one shared helper): persistent
+        # XLA compile cache on by default, size-bounded at startup — a
+        # long-lived heterogeneous-shape service is exactly the unbounded-
+        # growth workload (ADVICE r05).
+        from iterative_cleaner_tpu.utils.compile_cache import (
+            enable_and_trim_persistent_cache,
+        )
+
+        enable_and_trim_persistent_cache()
+    if args.smoke:
+        return run_smoke(serve_cfg)
+    service = CleaningService(serve_cfg)
+    try:
+        service.start()
+    except (RuntimeError, OSError) as exc:
+        # e.g. the spool's single-daemon flock, or EADDRINUSE on the bind —
+        # the operator contract is a one-line error + rc 1, not a traceback.
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("ict-serve: shutting down (unfinished jobs stay in the spool)",
+              file=sys.stderr)
+    finally:
+        service.stop()
+    return 0
+
+
+def console_main() -> int:
+    return serve_main(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    raise SystemExit(serve_main())

@@ -1,0 +1,126 @@
+"""Warm executable pool: precompile declared shape classes at startup.
+
+A serving daemon's reason to exist is that steady-state requests never see
+a cold XLA compile (20-40 s per kernel on TPU).  Operators declare the
+shape classes their telescope emits (``--warm NSUBxNCHANxNBIN``), and the
+pool compiles, before the API accepts traffic, every batched executable
+the scheduler can dispatch for them: one per power-of-two batch size up to
+the bucket cap (the closed set scheduler.pow2_chunks emits).
+
+Mechanics are the SurgicalCleaner precompile path's (backends/jax_backend
+.precompile_for): a DUMMY RUN on device zeros — the AOT lower().compile()
+path does not seed the executable cache the real call hits on this jax
+version — guarded by the same compile-cache accounting
+(already_noted/note_compiled_shape) under the same key the real bucket
+dispatch notes (compile_cache.batch_route_key), so a warmed shape is
+recognised and never re-warmed, and the ~70-executable segfault budget
+sees the warm compiles too.  On a zero cube the fused loop converges after
+one iteration, so the run cost is noise next to the compile.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from iterative_cleaner_tpu.utils import tracing
+from iterative_cleaner_tpu.utils.compile_cache import (
+    already_noted,
+    batch_route_key,
+    forget_noted,
+    note_compiled_shape,
+)
+
+
+def warm_batch_sizes(bucket_cap: int) -> list[int]:
+    """Every batch size the scheduler can emit for one shape: ALL powers of
+    two up to the cap — deadline flushes chunk to any pow2 size (a 3-cube
+    bucket under cap 8 emits [2, 1]), not just the cap itself."""
+    return [1 << k for k in range(bucket_cap.bit_length())
+            if (1 << k) <= bucket_cap]
+
+
+class WarmPool:
+    def __init__(self, cfg, mesh, bucket_cap: int, quiet: bool = False) -> None:
+        self.cfg = cfg
+        self.mesh = mesh
+        self.bucket_cap = int(bucket_cap)
+        self.quiet = quiet          # gates info lines; warnings stay loud
+        self.declared: tuple = ()   # shape classes declared at startup
+
+    def warm_shape(self, shape) -> int:
+        """Precompile the bucket executables for one (nsub, nchan, nbin)
+        shape class; returns how many batch sizes actually compiled.
+        Failures are swallowed per shape — warming is an optimization, the
+        real dispatch compiles normally."""
+        from iterative_cleaner_tpu.parallel.sharded import sharded_clean
+
+        shape = tuple(int(v) for v in shape)
+        compiled = 0
+        with tracing.phase("service_warm"):
+            for bsz in warm_batch_sizes(self.bucket_cap):
+                key = batch_route_key((bsz, *shape), self.cfg)
+                if already_noted(key):
+                    continue
+                # Note BEFORE compiling (start_precompile's rule): a due
+                # compile-cache drop lands here, not between the warm and
+                # a real dispatch of the same key.
+                note_compiled_shape(key)
+                try:
+                    Db = np.zeros((bsz, *shape), np.float32)
+                    w0b = np.zeros((bsz, *shape[:2]), np.float32)
+                    sharded_clean(Db, w0b, self.cfg, self.mesh)
+                    compiled += 1
+                except Exception as exc:  # noqa: BLE001 — best-effort, and
+                    # per size: one failed compile must neither skip the
+                    # remaining sizes nor leave its key claiming an
+                    # executable that was never built.
+                    forget_noted(key)
+                    print(f"ict-serve: warmup for shape {shape} batch "
+                          f"{bsz} failed: {exc}", file=sys.stderr)
+        return compiled
+
+    def warm_startup(self, shapes) -> None:
+        from iterative_cleaner_tpu.utils.compile_cache import (
+            DISTINCT_SHAPE_LIMIT,
+        )
+
+        self.declared = tuple(tuple(int(v) for v in s) for s in shapes)
+        n_keys = len(self.declared) * len(warm_batch_sizes(self.bucket_cap))
+        if n_keys >= DISTINCT_SHAPE_LIMIT:
+            # The executable-budget drop (jax.clear_caches every
+            # DISTINCT_SHAPE_LIMIT distinct keys — the virtual-CPU segfault
+            # guard) will fire DURING this warmup and discard earlier
+            # shapes' executables: only the last ~budget keys stay live
+            # (is_warm reports honestly; the persistent disk cache still
+            # shortens the re-compiles).  Say so instead of promising a
+            # warmth that self-destructs.
+            print(f"ict-serve: warning: {len(self.declared)} declared "
+                  f"shapes x {len(warm_batch_sizes(self.bucket_cap))} batch "
+                  f"sizes = {n_keys} executables exceeds the in-process "
+                  f"budget ({DISTINCT_SHAPE_LIMIT}); earlier shapes will "
+                  "re-compile on first dispatch — declare fewer shapes or "
+                  "lower --bucket_cap", file=sys.stderr)
+        for shape in self.declared:
+            n = self.warm_shape(shape)
+            if n and not self.quiet:
+                print(f"ict-serve: warmed shape {shape} "
+                      f"({n} batch-size executables)", file=sys.stderr)
+
+    def is_warm(self, shape) -> bool:
+        """Whether EVERY bucket executable for this shape is live right now.
+        Computed from the compile-cache guard's accounting rather than a
+        local set: a DISTINCT_SHAPE_LIMIT drop (jax.clear_caches at 20
+        distinct executable keys — it also clears the accounting) silently
+        discards warmed executables, and a stale local set would keep
+        reporting warmth that no longer exists.  After a drop the next
+        dispatch of each size re-warms naturally (and re-notes the key)."""
+        shape = tuple(int(v) for v in shape)
+        return all(
+            already_noted(batch_route_key((bsz, *shape), self.cfg))
+            for bsz in warm_batch_sizes(self.bucket_cap))
+
+    def warm_shapes_now(self) -> list[tuple]:
+        """The declared shapes currently fully warm (the /healthz view)."""
+        return [s for s in self.declared if self.is_warm(s)]

@@ -1,0 +1,121 @@
+"""Shape-bucketed admission: fill a data-parallel slice or hit a deadline.
+
+The batching rules are the ones `parallel/batch.py` established for
+directories, applied to a continuous arrival stream:
+
+- same-shape cubes stack into ONE sharded dispatch (one archive per dp
+  slice; zero-weight padding is never used — it would perturb the
+  mask-blind FFT diagnostic, see parallel/sharded.py);
+- a bucket flushes the moment it holds ``bucket_cap`` cubes (default: the
+  mesh's dp extent — a full data-parallel slice), or when its OLDEST entry
+  has waited ``deadline_s`` (latency bound for sparse traffic);
+- deadline flushes are chunked to power-of-two batch sizes, the
+  clean_directory_streaming pressure-flush trick: the batched executable
+  specializes on batch size, so pow2 chunking bounds the compile set to
+  O(log cap) sizes per shape — exactly the set service/pool.py precompiles
+  at startup, which is what makes "an already-warm shape never compiles"
+  hold for partial buckets too.
+
+The scheduler owns no threads: the daemon's loader threads call
+:meth:`offer` and a tick loop calls :meth:`tick`; ``flush_fn(entries)``
+must be cheap (the worker enqueues, it does not dispatch inline).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from iterative_cleaner_tpu.io.base import Archive
+from iterative_cleaner_tpu.service.jobs import Job
+from iterative_cleaner_tpu.utils import tracing
+
+
+@dataclass
+class Entry:
+    """One admitted job with its decoded cube (host arrays)."""
+
+    job: Job
+    archive: Archive
+    D: np.ndarray
+    w0: np.ndarray
+    arrived_s: float            # time.monotonic() — immune to clock steps
+
+
+def pow2_chunks(n: int, cap: int) -> list[int]:
+    """Split ``n`` into power-of-two chunk sizes <= cap, largest first
+    (5, cap 4 -> [4, 1]) — the closed set of batch sizes the scheduler can
+    emit, {1, 2, 4, ..., cap}."""
+    sizes = []
+    while n > 0:
+        k = 1 << (n.bit_length() - 1)
+        k = min(k, 1 << (cap.bit_length() - 1))
+        sizes.append(k)
+        n -= k
+    return sizes
+
+
+class ShapeBucketScheduler:
+    def __init__(self, bucket_cap: int, deadline_s: float, flush_fn) -> None:
+        if bucket_cap < 1:
+            raise ValueError(f"bucket_cap must be >= 1, got {bucket_cap}")
+        # Clamp to a power of two HERE, in the mechanism that owns the
+        # invariant: full-bucket flushes emit exactly bucket_cap entries
+        # unchunked, and the warm pool only precompiles pow2 batch sizes —
+        # a cap of 3 would dispatch batches no warm set covers.
+        self.bucket_cap = 1 << (int(bucket_cap).bit_length() - 1)
+        self.deadline_s = float(deadline_s)
+        self._flush_fn = flush_fn
+        self._buckets: dict[tuple, list[Entry]] = {}
+        self._lock = threading.Lock()
+
+    def offer(self, job: Job, archive: Archive, D, w0) -> None:
+        """Admit one decoded cube; flushes its bucket if that fills a dp
+        slice.  Shape is the preprocessed-cube shape — the executable
+        identity, exactly the key parallel/batch buckets on."""
+        entry = Entry(job=job, archive=archive, D=D, w0=w0,
+                      arrived_s=time.monotonic())
+        job.shape = list(D.shape)
+        flush = None
+        with self._lock:
+            group = self._buckets.setdefault(tuple(D.shape), [])
+            group.append(entry)
+            if len(group) >= self.bucket_cap:
+                flush = self._buckets.pop(tuple(D.shape))
+        if flush:
+            tracing.count("service_bucket_full_flushes")
+            self._flush_fn(flush)
+
+    def tick(self, now: float | None = None) -> None:
+        """Flush every bucket whose oldest entry has exceeded the deadline,
+        in pow2 chunks (see module docstring)."""
+        now = time.monotonic() if now is None else now
+        due: list[list[Entry]] = []
+        with self._lock:
+            for shape in [s for s, g in self._buckets.items()
+                          if now - g[0].arrived_s >= self.deadline_s]:
+                due.append(self._buckets.pop(shape))
+        for group in due:
+            tracing.count("service_bucket_deadline_flushes")
+            self._emit_chunks(group)
+
+    def flush_all(self) -> None:
+        """Drain everything (shutdown / drain barrier)."""
+        with self._lock:
+            groups = list(self._buckets.values())
+            self._buckets.clear()
+        for group in groups:
+            self._emit_chunks(group)
+
+    def _emit_chunks(self, group: list[Entry]) -> None:
+        i = 0
+        for size in pow2_chunks(len(group), self.bucket_cap):
+            self._flush_fn(group[i: i + size])
+            i += size
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return sum(len(g) for g in self._buckets.values())

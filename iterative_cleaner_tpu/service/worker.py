@@ -1,0 +1,222 @@
+"""Dispatch worker: fault-isolated execution of flushed shape buckets.
+
+One thread owns the device (JAX dispatch is not re-entrant across threads
+without care, and the bucket executables serialize on the chip anyway); the
+loader threads and the HTTP server stay responsive while it runs.  The
+failure ladder, top to bottom:
+
+1. a job whose archive fails to DECODE never reaches this worker — the
+   loader marks it ``error`` alone (the parallel/batch isolation rule);
+2. a sharded bucket dispatch that throws is retried with exponential
+   backoff (``dispatch_retries`` / ``retry_backoff_s``) — the dev-tunnel
+   failure mode is a transient RPC error on first contact (bench.py
+   learned this in r01);
+3. retries exhausted: every still-unfinished job in the bucket degrades to
+   the numpy ORACLE backend, individually — slower, but masks are the
+   oracle's by definition, and one poisoned cube cannot take its bucket
+   siblings down;
+4. repeated bucket failures demote the whole service to oracle mode
+   (daemon.note_dispatch_failure), the serving analog of the CLI's
+   wedged-tunnel CPU demotion (utils/device_probe.py).
+"""
+
+from __future__ import annotations
+
+import queue
+import sys
+import threading
+import time
+
+import numpy as np
+
+from iterative_cleaner_tpu.service.jobs import TERMINAL, Job
+from iterative_cleaner_tpu.service.scheduler import Entry
+from iterative_cleaner_tpu.utils import tracing
+
+_STOP = object()
+
+
+class DispatchWorker(threading.Thread):
+    """Consumes entry groups (same-shape buckets) from the scheduler."""
+
+    def __init__(self, service) -> None:
+        super().__init__(daemon=True, name="ict-serve-dispatch")
+        self.service = service
+        self._q: queue.Queue = queue.Queue()
+
+    def submit(self, entries: list[Entry]) -> None:
+        self._q.put(entries)
+
+    def stop(self) -> None:
+        self._q.put(_STOP)
+
+    def run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                return
+            try:
+                self._dispatch(item)
+            except Exception as exc:  # noqa: BLE001 — the thread must live
+                for e in item:
+                    if e.job.state not in TERMINAL:
+                        self._fail(e.job, f"dispatch worker error: {exc}")
+
+    # --- the failure ladder ---
+
+    def _dispatch(self, entries: list[Entry]) -> None:
+        svc = self.service
+        for e in entries:
+            e.job.state = "running"
+            svc.spool.save(e.job)
+        if svc.backend_mode == "jax":
+            err = self._try_sharded(entries)
+            if err is None:
+                return
+            tracing.count("service_oracle_fallbacks")
+            print(f"ict-serve: sharded dispatch failed after retries ({err}); "
+                  f"serving {len(entries)} job(s) via the numpy oracle",
+                  file=sys.stderr)
+        # "oracle" = the configured numpy route; "oracle-fallback" = the
+        # degraded one — an intentionally-numpy deployment must not raise
+        # permanent fallback alarms.
+        label = ("oracle" if svc.clean_cfg.backend == "numpy"
+                 else "oracle-fallback")
+        for e in entries:
+            if e.job.state not in TERMINAL:
+                self._clean_oracle(e, label)
+
+    def _try_sharded(self, entries: list[Entry]):
+        """Bounded retry around one bucket dispatch; returns the final
+        exception, or None on success."""
+        svc = self.service
+        delay = svc.serve_cfg.retry_backoff_s
+        last = None
+        for attempt in range(1 + svc.serve_cfg.dispatch_retries):
+            live = [e for e in entries if e.job.state not in TERMINAL]
+            if not live:
+                return None
+            if attempt:
+                tracing.count("service_dispatch_retries")
+                time.sleep(delay)
+                delay *= 2
+            for e in live:
+                e.job.attempts += 1
+            try:
+                self._dispatch_sharded(live)
+                svc.note_dispatch_ok()
+                return None
+            except Exception as exc:  # noqa: BLE001 — retried, then degraded
+                last = exc
+        svc.note_dispatch_failure(last)
+        return last
+
+    def _dispatch_sharded(self, entries: list[Entry]) -> None:
+        """One stacked bucket on the mesh — literally the directory-batch
+        dispatcher (_finish_bucket: note_compiled_shape bounding, bad-parts
+        sweep, per-item emission), fed from the admission queue instead of
+        a directory listing."""
+        from iterative_cleaner_tpu.parallel.batch import (
+            BatchItem,
+            _finish_bucket,
+        )
+
+        svc = self.service
+        items = [BatchItem(path=e.job.path, archive=e.archive)
+                 for e in entries]
+        Db = np.stack([e.D for e in entries])
+        w0b = np.stack([e.w0 for e in entries])
+
+        emit_s = [0.0]
+
+        def on_item(i, item) -> None:
+            # Emission failures are per-job: they must neither abort the
+            # bucket loop for the sibling jobs nor read as a (retryable)
+            # dispatch failure.
+            t0 = time.perf_counter()
+            try:
+                self._emit(entries[i], item.weights, item.loops,
+                           item.converged, item.rfi_frac, "sharded")
+            except Exception as exc:  # noqa: BLE001 — isolate the one job
+                self._fail(entries[i].job, f"output emission failed: {exc}")
+            finally:
+                dt = time.perf_counter() - t0
+                emit_s[0] += dt
+                tracing.observe_phase("service_emit", dt)
+
+        t0 = time.perf_counter()
+        try:
+            _finish_bucket(items, list(range(len(items))), Db, w0b,
+                           svc.clean_cfg, svc.mesh, on_item=on_item)
+        finally:
+            # _finish_bucket calls on_item inline, so subtract the emission
+            # seconds: the per-stage means (_s/_n) must not double-count
+            # I/O time as device-dispatch time.  try/finally so FAILED
+            # dispatches count too (tracing.phase's rule) — a backend
+            # incident must not make the mean dispatch latency look healthy.
+            tracing.observe_phase(
+                "service_dispatch", time.perf_counter() - t0 - emit_s[0])
+
+    def _clean_oracle(self, e: Entry, served_by: str = "oracle-fallback") -> None:
+        """The numpy-oracle route, one job at a time (isolated)."""
+        from iterative_cleaner_tpu.core.cleaner import clean_cube
+        from iterative_cleaner_tpu.parallel.batch import finalize_weights
+
+        svc = self.service
+        try:
+            with tracing.phase("service_oracle"):
+                cfg = svc.clean_cfg.replace(backend="numpy")
+                res = clean_cube(e.D, e.w0, cfg)
+                final_w, rfi = finalize_weights(res.weights, cfg)
+                self._emit(e, final_w, res.loops, res.converged, rfi,
+                           served_by)
+        except Exception as exc:  # noqa: BLE001 — isolate, report, continue
+            self._fail(e.job, str(exc))
+
+    # --- terminal transitions ---
+
+    def _emit(self, e: Entry, weights, loops, converged, rfi_frac,
+              served_by: str) -> None:
+        from iterative_cleaner_tpu.driver import atomic_save, output_name
+        from iterative_cleaner_tpu.io.base import get_io
+        from iterative_cleaner_tpu.models.surgical import apply_output_policy
+
+        svc = self.service
+        job = e.job
+        cleaned = apply_output_policy(e.archive, np.asarray(weights), svc.clean_cfg)
+        o_name = output_name(svc.clean_cfg, e.archive, job.path)
+        atomic_save(get_io(job.path), cleaned, o_name)
+        job.out_path = o_name
+        job.loops = int(loops)
+        job.converged = bool(converged)
+        job.rfi_frac = float(rfi_frac)
+        job.served_by = served_by
+        job.state = "done"
+        job.finished_s = time.time()
+        svc.spool.save(job)
+        svc.retire(job)
+        tracing.count("service_jobs_done")
+        # Release the decoded cube — steady-state host residency stays
+        # bounded by the admission queue, not the job history.
+        e.archive = e.D = e.w0 = None
+
+    def _fail(self, job: Job, msg: str) -> None:
+        """Terminal error transition.  Must NEVER raise: it is the last
+        resort of the dispatch and loader threads, and a spool write that
+        fails (disk full, spool dir removed) would otherwise kill the only
+        dispatch thread while /healthz keeps reporting ok."""
+        job.state = "error"
+        job.error = msg
+        job.finished_s = time.time()
+        try:
+            self.service.spool.save(job)
+            self.service.retire(job)
+        except Exception as exc:  # noqa: BLE001 — keep the job in memory:
+            # with the manifest unwritten, the in-memory record is the only
+            # true view of its state (GET /jobs/<id> reads it first).
+            tracing.count("service_spool_save_errors")
+            print(f"ict-serve: spool save failed for job {job.id}: {exc}",
+                  file=sys.stderr)
+        tracing.count("service_jobs_error")
+        print(f"ict-serve: job {job.id} ({job.path}) failed: {msg}",
+              file=sys.stderr)

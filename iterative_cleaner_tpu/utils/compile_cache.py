@@ -47,6 +47,101 @@ def inmemory_route_key(shape, cfg, want_residual: bool) -> tuple:
     return (nsub, nchan, nbin, "stepwise", pallas, cfg.x64, incremental, pr)
 
 
+def batch_route_key(batch_shape, cfg) -> tuple:
+    """The compile-cache key for one sharded BATCH dispatch (directory
+    buckets and the serving daemon's shape buckets alike):
+    ``batch_shape`` is the stacked (batch, nsub, nchan, nbin).  Mirrors
+    batched_fused_clean's static-arg surface (max_iter, pulse_region).  No
+    x64 axis: the batch route has no x64 handling (preprocess emits f32 and
+    the sharded kernel never casts), so both cfg.x64 values reuse one
+    executable.  Shared by parallel/batch._finish_bucket and the service
+    warm pool (service/pool.py) so the dispatcher's accounting and the
+    warm-skip check can never disagree."""
+    return (*batch_shape, "batch", cfg.max_iter, tuple(cfg.pulse_region))
+
+
+# Size bound for the CLI-default persistent cache (ADVICE r05: the 0-second
+# min-compile-time floor serializes every executable, so a long-lived
+# heterogeneous workload — and especially the serving daemon — grows the
+# directory without bound).  2 GiB holds hundreds of TPU executables; the
+# trim is FIFO by mtime, so the oldest-written entries go first.
+CACHE_TRIM_DEFAULT_MB = 2048
+
+
+def trim_persistent_cache(path: str | None = None,
+                          max_bytes: int | None = None) -> int:
+    """Delete oldest-written entries until the persistent-cache directory is
+    under ``max_bytes`` (default ``ICT_COMPILE_CACHE_MAX_MB``, 2048; <= 0
+    disables).  Returns bytes removed.  Called on CLI startup and on
+    serving-daemon startup — the two places the cache is enabled by
+    default; the directory stays user-prunable by hand (documented in
+    README).  Best-effort like the cache itself: a vanished file or an
+    unreadable directory trims nothing rather than failing the run."""
+    import os
+
+    if max_bytes is None:
+        env = os.environ.get("ICT_COMPILE_CACHE_MAX_MB",
+                             str(CACHE_TRIM_DEFAULT_MB))
+        try:
+            mb = float(env)
+        except ValueError:
+            import sys
+
+            print(f"warning: ignoring unparseable ICT_COMPILE_CACHE_MAX_MB"
+                  f"={env!r}; using the {CACHE_TRIM_DEFAULT_MB} MB default",
+                  file=sys.stderr)
+            mb = CACHE_TRIM_DEFAULT_MB
+        max_bytes = int(mb * 1e6)
+    if max_bytes <= 0:
+        return 0
+    path = path or _default_cache_dir()
+    try:
+        entries = []
+        for root, _dirs, files in os.walk(path):
+            for name in files:
+                p = os.path.join(root, name)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                entries.append((st.st_mtime, st.st_size, p))
+        total = sum(size for _, size, _ in entries)
+        removed = 0
+        for _mtime, size, p in sorted(entries):
+            if total - removed <= max_bytes:
+                break
+            try:
+                os.remove(p)
+                removed += size
+            except OSError:
+                continue
+        return removed
+    except Exception:  # noqa: BLE001 — trimming is opportunistic
+        return 0
+
+
+def _default_cache_dir() -> str:
+    import os
+
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "iterative_cleaner_tpu", "xla")
+
+
+def enable_and_trim_persistent_cache() -> str | None:
+    """The CLI-layer policy in one place (cli.main and the ict-serve
+    daemon both apply it): enable the persistent cache, then size-bound it
+    — but ONLY when the directory in effect is the tool-owned default.  An
+    explicit JAX_COMPILATION_CACHE_DIR may be a cache shared with other
+    JAX workloads, and deleting their 20-40 s TPU compiles to enforce our
+    bound is not this tool's call (the dir is 'used as-is', eviction
+    included).  Returns the directory in effect, or None when
+    disabled/failed."""
+    path = enable_persistent_cache()
+    if path and path == _default_cache_dir():
+        trim_persistent_cache(path)
+    return path
+
+
 def enable_persistent_cache(path: str | None = None) -> str | None:
     """Point XLA's persistent compilation cache at a writable directory so
     *separate processes* skip recompiling identical kernels — a cold CLI
@@ -67,8 +162,8 @@ def enable_persistent_cache(path: str | None = None) -> str | None:
 
     if os.environ.get("ICT_NO_COMPILE_CACHE") == "1":
         return None
-    path = path or os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
-        os.path.expanduser("~"), ".cache", "iterative_cleaner_tpu", "xla")
+    path = (path or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+            or _default_cache_dir())
     try:
         os.makedirs(path, exist_ok=True)
         import jax
@@ -117,6 +212,14 @@ def already_noted(key: tuple) -> bool:
     its executables are (or are being) compiled in this process.  The warm
     path uses it to skip redundant dummy runs for same-shape archives."""
     return tuple(key) in _seen
+
+
+def forget_noted(key: tuple) -> None:
+    """Withdraw a key that was noted optimistically before a compile that
+    then FAILED (the service warm pool's per-size accounting): leaving it
+    would make already_noted report an executable that was never built, so
+    the real dispatch would skip a warm it still needs."""
+    _seen.discard(tuple(key))
 
 
 def note_compiled_shape(key: tuple) -> bool:

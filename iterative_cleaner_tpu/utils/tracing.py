@@ -1,9 +1,12 @@
 """Profiling / tracing hooks (SURVEY.md §5: the reference has none; the TPU
-framework exposes jax.profiler traces plus per-iteration host timings)."""
+framework exposes jax.profiler traces plus per-iteration host timings) —
+plus the process-global phase counters the serving daemon's ``/metrics``
+endpoint reports (service/api.py)."""
 
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 
 
@@ -18,6 +21,59 @@ def profile_trace(trace_dir: str | None):
 
     with jax.profiler.trace(trace_dir):
         yield
+
+
+# --- per-phase counters (the serving daemon's /metrics source) ---
+#
+# A deliberately tiny metrics registry: monotonic floats keyed by name,
+# process-global so every layer (driver, batch dispatch, service worker)
+# can account into one place without plumbing a registry object through
+# call signatures.  ``observe_phase`` follows the Prometheus summary
+# convention (``<name>_s`` total seconds + ``<name>_n`` count), which is
+# what the per-stage accounting of astronomical pipelines needs
+# ("Pipeline Collector", arXiv:1807.05733): mean stage latency is
+# ``load_s / load_n`` with no histogram machinery.
+
+_counters: dict[str, float] = {}
+_counters_lock = threading.Lock()
+
+
+def count(name: str, inc: float = 1.0) -> None:
+    """Add ``inc`` to the process-global counter ``name``."""
+    with _counters_lock:
+        _counters[name] = _counters.get(name, 0.0) + inc
+
+
+def observe_phase(name: str, seconds: float) -> None:
+    """Record one completed phase: total seconds + occurrence count."""
+    with _counters_lock:
+        _counters[f"{name}_s"] = _counters.get(f"{name}_s", 0.0) + seconds
+        _counters[f"{name}_n"] = _counters.get(f"{name}_n", 0.0) + 1.0
+
+
+@contextlib.contextmanager
+def phase(name: str):
+    """Time a block into :func:`observe_phase` (exceptions still count —
+    a failing load is still a load the operator wants in the latency
+    accounting)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        observe_phase(name, time.perf_counter() - t0)
+
+
+def counters_snapshot() -> dict[str, float]:
+    """Point-in-time copy of every counter, sorted by name (stable JSON)."""
+    with _counters_lock:
+        return dict(sorted(_counters.items()))
+
+
+def reset_counters() -> None:
+    """Zero the registry (tests only — production counters are cumulative
+    for the life of the process, like any scrape target)."""
+    with _counters_lock:
+        _counters.clear()
 
 
 class StepTimer:

@@ -46,6 +46,44 @@ def cpu_devices():
     return jax.devices("cpu")
 
 
+@pytest.fixture()
+def compile_events():
+    """Record jax backend-compile events (the monitoring-listener evidence
+    pattern: tests/test_precompile.py pins warm-path cache hits with it,
+    tests/test_service.py the daemon's warm pool).
+
+    Resets BOTH process-global caches first: leftover executables would hide
+    compiles, and a near-limit compile_cache counter would fire a
+    jax.clear_caches() drop between warmup and the real call (suite-order
+    flake, reproduced in review).
+    """
+    from jax._src import monitoring
+
+    from iterative_cleaner_tpu.utils import compile_cache
+
+    jax.clear_caches()
+    compile_cache._seen.clear()
+
+    events: list[tuple[str, float]] = []
+
+    def cb(name, dur, **kw):
+        events.append((name, dur))
+
+    monitoring.register_event_duration_secs_listener(cb)
+    yield events
+    # The public unregister only exists on newer jax; fall back to the
+    # by-callback private spelling (jax 0.4.x).
+    fn = getattr(monitoring, "unregister_event_duration_listener", None)
+    if fn is None:
+        fn = monitoring._unregister_event_duration_listener_by_callback
+    fn(cb)
+
+
+def backend_compiles(events) -> list[float]:
+    """The subset of monitoring events that are real backend compiles."""
+    return [d for n, d in events if n.endswith("backend_compile_duration")]
+
+
 @pytest.fixture(scope="session")
 def small_archive():
     """Config #1 scale: 8 x 64 x 256 with the full RFI menagerie."""

@@ -68,3 +68,22 @@ def test_exception_path_still_emits_json():
     for key in DRIVER_KEYS:
         assert key in payload, key
     assert "error" in payload and payload["error"]
+
+
+def test_mirror_name_isolates_fallback_and_error_artifacts(monkeypatch):
+    """The docs/ mirror must never clobber the canonical same-platform
+    artifact with a demoted (tpu_unreachable) or error payload (ADVICE
+    r05) — fast unit check of the pure naming helper."""
+    import bench
+
+    monkeypatch.delenv("BENCH_MIRROR_TAG", raising=False)
+    assert bench._mirror_name({"device": "cpu:host"}) == "bench_last_cpu.json"
+    assert bench._mirror_name(
+        {"device": "cpu:host", "tpu_unreachable": True}
+    ) == "bench_last_cpu_fallback.json"
+    assert bench._mirror_name(
+        {"device": "cpu:host", "tpu_unreachable": True, "error": "boom"}
+    ) == "bench_last_cpu_fallback_error.json"
+    monkeypatch.setenv("BENCH_MIRROR_TAG", "hw_watch")
+    assert bench._mirror_name(
+        {"device": "tpu:TPU v5 lite"}) == "bench_last_tpu_hw_watch.json"

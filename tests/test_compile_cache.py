@@ -192,3 +192,92 @@ class TestPersistentCache:
         np.asarray(_probe_kernel(jnp.arange(1024.0)))
         files = list(target.rglob("*"))
         assert any(f.is_file() for f in files), files
+
+
+class TestPersistentCacheTrim:
+    """Size-bounded trim of the CLI-default persistent cache (ADVICE r05):
+    oldest-written entries go first, and the bound is env-tunable."""
+
+    def _fill(self, tmp_path, n=4, size=100):
+        import os
+        import time
+
+        paths = []
+        for i in range(n):
+            p = tmp_path / f"entry{i}.bin"
+            p.write_bytes(b"x" * size)
+            t = time.time() - (n - i) * 100  # entry0 oldest
+            os.utime(p, (t, t))
+            paths.append(p)
+        return paths
+
+    def test_trims_oldest_first_to_bound(self, tmp_path):
+        paths = self._fill(tmp_path, n=4, size=100)
+        removed = compile_cache.trim_persistent_cache(
+            str(tmp_path), max_bytes=250)
+        assert removed == 200  # the two oldest go; 200 bytes remain
+        assert [p.exists() for p in paths] == [False, False, True, True]
+
+    def test_under_bound_is_untouched(self, tmp_path):
+        paths = self._fill(tmp_path, n=3, size=10)
+        assert compile_cache.trim_persistent_cache(
+            str(tmp_path), max_bytes=1000) == 0
+        assert all(p.exists() for p in paths)
+
+    def test_env_bound_and_disable(self, tmp_path, monkeypatch):
+        paths = self._fill(tmp_path, n=2, size=1000)
+        monkeypatch.setenv("ICT_COMPILE_CACHE_MAX_MB", "0")
+        assert compile_cache.trim_persistent_cache(str(tmp_path)) == 0
+        assert all(p.exists() for p in paths)
+        monkeypatch.setenv("ICT_COMPILE_CACHE_MAX_MB", "0.001")  # 1000 bytes
+        assert compile_cache.trim_persistent_cache(str(tmp_path)) == 1000
+        assert [p.exists() for p in paths] == [False, True]
+
+    def test_missing_directory_is_harmless(self, tmp_path):
+        assert compile_cache.trim_persistent_cache(
+            str(tmp_path / "nope"), max_bytes=1) == 0
+
+
+def test_batch_route_key_is_shared_with_the_bucket_dispatcher():
+    """The warm pool skips dummy runs via the exact key _finish_bucket
+    notes; the helper is the single source so the two can never drift."""
+    cfg = CleanConfig(backend="jax", max_iter=3)
+    key = compile_cache.batch_route_key((2, 8, 64, 256), cfg)
+    assert key == (2, 8, 64, 256, "batch", 3, (0.0, 0.0, 1.0))
+    # x64 deliberately absent: the batch route compiles one executable set
+    # for both cfg.x64 values (see the helper's docstring).
+    assert key == compile_cache.batch_route_key(
+        (2, 8, 64, 256), cfg.replace(x64=True))
+
+
+class TestEnableAndTrimScope:
+    """enable_and_trim sets the process-global jax cache config, which
+    later suite files (the compile-evidence tests) must not see — same
+    restore discipline as TestPersistentCache."""
+
+    @pytest.fixture(autouse=True)
+    def _restore_config(self):
+        import jax
+
+        before = jax.config.jax_compilation_cache_dir
+        before_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        yield
+        jax.config.update("jax_compilation_cache_dir", before)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          before_min)
+        compile_cache._reset_cache_state()
+
+    def test_never_trims_an_explicit_shared_dir(self, tmp_path, monkeypatch):
+        """An explicit JAX_COMPILATION_CACHE_DIR may be shared with other
+        JAX workloads: the CLI-layer helper must enable it as-is and never
+        evict entries there — the size bound applies only to the
+        tool-owned default."""
+        monkeypatch.delenv("ICT_NO_COMPILE_CACHE", raising=False)
+        shared = tmp_path / "shared"
+        shared.mkdir()
+        foreign = shared / "other-workload-executable.bin"
+        foreign.write_bytes(b"x" * 1000)
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(shared))
+        monkeypatch.setenv("ICT_COMPILE_CACHE_MAX_MB", "0.0000001")
+        assert compile_cache.enable_and_trim_persistent_cache() == str(shared)
+        assert foreign.exists()

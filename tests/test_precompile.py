@@ -21,31 +21,9 @@ from iterative_cleaner_tpu.ops.preprocess import preprocess
 from iterative_cleaner_tpu.utils import compile_cache
 
 
-@pytest.fixture()
-def compile_events():
-    import jax
-
-    from jax._src import monitoring
-
-    # Reset BOTH process-global caches: leftover executables would hide
-    # compiles, and a near-limit compile_cache counter would fire a
-    # jax.clear_caches() drop between warmup and the real call (suite-order
-    # flake, reproduced in review).
-    jax.clear_caches()
-    compile_cache._seen.clear()
-
-    events: list[tuple[str, float]] = []
-
-    def cb(name, dur, **kw):
-        events.append((name, dur))
-
-    monitoring.register_event_duration_secs_listener(cb)
-    yield events
-    monitoring.unregister_event_duration_listener(cb)
-
-
-def _backend_compiles(events) -> list[float]:
-    return [d for n, d in events if n.endswith("backend_compile_duration")]
+# The compile_events fixture (shared with tests/test_service.py) lives in
+# conftest.py, drift-tolerant unregister included.
+from conftest import backend_compiles as _backend_compiles  # noqa: E402
 
 
 @pytest.mark.parametrize("cfgkw", [

@@ -1,0 +1,531 @@
+"""ict-serve daemon end-to-end on the virtual 8-device CPU mesh.
+
+The acceptance contract (ISSUE 1): mixed-shape jobs submitted over real
+HTTP come back with masks bit-identical to the numpy oracle; a poisoned
+archive fails alone; /healthz and /metrics respond; a spool survives a
+daemon restart; and an already-warm shape dispatches with ZERO new backend
+compiles (the monitoring-listener evidence pattern of test_precompile.py).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import backend_compiles
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube
+from iterative_cleaner_tpu.io.npz import NpzIO
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.ops.preprocess import preprocess
+from iterative_cleaner_tpu.parallel.mesh import make_mesh
+from iterative_cleaner_tpu.service import CleaningService, ServeConfig
+from iterative_cleaner_tpu.service.jobs import Job, JobSpool
+from iterative_cleaner_tpu.service.scheduler import (
+    ShapeBucketScheduler,
+    pow2_chunks,
+)
+from iterative_cleaner_tpu.utils import tracing
+
+
+def _write(tmp_path, name, nsub=8, seed=0):
+    p = str(tmp_path / name)
+    NpzIO().save(make_archive(nsub=nsub, nchan=16, nbin=64, seed=seed), p)
+    return p
+
+
+def _start(tmp_path, **kw):
+    mesh = make_mesh(8, devices=jax.devices("cpu"))
+    defaults = dict(spool_dir=str(tmp_path / "spool"), port=0,
+                    deadline_s=0.2, quiet=True, retry_backoff_s=0.01,
+                    clean=CleanConfig(backend="jax", max_iter=3, quiet=True,
+                                      no_log=True))
+    defaults.update(kw)
+    svc = CleaningService(ServeConfig(**defaults), mesh=mesh)
+    svc.start()
+    return svc
+
+
+def _post_job(svc, path):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{svc.port}/jobs",
+        data=json.dumps({"path": path}).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.load(urllib.request.urlopen(req, timeout=30))
+
+
+def _get(svc, route, expect_error=False):
+    try:
+        return json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}{route}", timeout=30))
+    except urllib.error.HTTPError as exc:
+        if expect_error:
+            return exc.code
+        raise
+
+
+def _oracle_weights(path, max_iter=3):
+    return clean_cube(*preprocess(NpzIO().load(path)),
+                      CleanConfig(backend="numpy", max_iter=max_iter)).weights
+
+
+def test_warm_sizes_cover_every_deadline_chunk():
+    """The warm set must contain EVERY pow2 size a deadline flush can emit,
+    not just {1, cap} — a 3-cube bucket under cap 8 dispatches [2, 1]."""
+    from iterative_cleaner_tpu.service.pool import warm_batch_sizes
+
+    assert warm_batch_sizes(8) == [1, 2, 4, 8]
+    assert warm_batch_sizes(2) == [1, 2]
+    assert warm_batch_sizes(1) == [1]
+    for cap in (1, 2, 4, 8):
+        for n in range(1, 3 * cap):
+            assert set(pow2_chunks(n, cap)) <= set(warm_batch_sizes(cap))
+
+
+class TestSchedulerUnits:
+    def test_pow2_chunks(self):
+        assert pow2_chunks(5, 4) == [4, 1]
+        assert pow2_chunks(3, 4) == [2, 1]
+        assert pow2_chunks(4, 4) == [4]
+        assert pow2_chunks(1, 8) == [1]
+        assert pow2_chunks(7, 2) == [2, 2, 2, 1]
+
+    def _entry(self, nsub=4):
+        D = np.zeros((nsub, 3, 8), np.float32)
+        return (Job(id="j", path="x"), None, D, np.zeros((nsub, 3), np.float32))
+
+    def test_full_bucket_flushes_immediately(self):
+        flushed = []
+        s = ShapeBucketScheduler(2, 999.0, flushed.append)
+        s.offer(*self._entry())
+        assert flushed == [] and s.pending_count() == 1
+        s.offer(*self._entry())
+        assert len(flushed) == 1 and len(flushed[0]) == 2
+        assert s.pending_count() == 0
+
+    def test_deadline_flush_chunks_pow2(self):
+        flushed = []
+        s = ShapeBucketScheduler(4, 1.0, flushed.append)
+        for _ in range(3):
+            s.offer(*self._entry())
+        s.tick(now=s._buckets[(4, 3, 8)][0].arrived_s + 0.5)
+        assert flushed == []  # deadline not reached
+        s.tick(now=flushed_deadline(s) + 2.0)
+        assert [len(g) for g in flushed] == [2, 1]
+        assert s.pending_count() == 0
+
+    def test_shapes_never_mix(self):
+        flushed = []
+        s = ShapeBucketScheduler(2, 999.0, flushed.append)
+        s.offer(*self._entry(nsub=4))
+        s.offer(*self._entry(nsub=6))
+        assert flushed == [] and s.pending_count() == 2
+        s.flush_all()
+        assert sorted(e.D.shape[0] for g in flushed for e in g) == [4, 6]
+
+
+def flushed_deadline(s):
+    return max(g[0].arrived_s for g in s._buckets.values())
+
+
+class TestJobSpool:
+    def test_foreign_json_never_crashes_the_replay(self, tmp_path):
+        """One operator note (or schema-drifted manifest) in the spool must
+        degrade to 'not a job', not crash-loop every daemon start."""
+        spool = JobSpool(str(tmp_path / "spool"))
+        ok = spool.create("good.npz")
+        (tmp_path / "spool" / "note.json").write_text('{"comment": "hi"}\n')
+        (tmp_path / "spool" / "list.json").write_text("[]\n")
+        (tmp_path / "spool" / "junk.json").write_text("not json\n")
+        # a manifest whose CONTENT id does not round-trip to its filename
+        # (traversal-shaped or just mismatched) must be skipped, not crash
+        # the replay's re-persist or duplicate the job under a second name
+        (tmp_path / "spool" / "evil.json").write_text(
+            '{"id": "../escape", "path": "x", "state": "running"}\n')
+        (tmp_path / "spool" / "alias.json").write_text(
+            '{"id": "other-name", "path": "x", "state": "running"}\n')
+        pending = JobSpool(str(tmp_path / "spool")).recover()
+        assert [j.id for j in pending] == [ok.id]
+
+    def test_job_id_cannot_escape_the_spool(self, tmp_path):
+        """Ids come straight off the HTTP path: traversal-shaped ids must
+        resolve to nothing, not to files outside the spool."""
+        outside = tmp_path / "secret.json"
+        outside.write_text('{"id": "x", "path": "leak"}\n')
+        spool = JobSpool(str(tmp_path / "spool"))
+        for bad in ("../secret", "a/../../secret", "/etc/passwd", ".hidden"):
+            assert spool.get(bad) is None
+        with pytest.raises(ValueError):
+            spool.save(Job(id="../escape", path="x"))
+
+    def test_trim_prunes_old_terminal_only(self, tmp_path):
+        spool = JobSpool(str(tmp_path / "spool"))
+        jobs = []
+        for i in range(4):
+            jobs.append(spool.create(f"{i}.npz"))
+            time.sleep(0.002)  # distinct id timestamps: ids are ms-sortable
+            #                    and same-ms ties order by the random suffix
+        for j in jobs[:3]:
+            j.state = "done"
+            spool.save(j)
+        orphan = tmp_path / "spool" / "dead.json.part"
+        orphan.write_text("{")  # crash mid-save leftover
+        assert spool.trim(keep_terminal=1) == 2  # two oldest done go
+        left = {j.id for j in spool.all_jobs()}
+        assert left == {jobs[2].id, jobs[3].id}  # newest done + the pending
+        assert not orphan.exists()
+
+    def test_roundtrip_and_recover(self, tmp_path):
+        spool = JobSpool(str(tmp_path / "spool"))
+        a = spool.create("a.npz")
+        time.sleep(0.002)  # distinct id timestamps (submission-order assert)
+        b = spool.create("b.npz")
+        time.sleep(0.002)
+        done = spool.create("c.npz")
+        b.state = "running"
+        spool.save(b)
+        done.state = "done"
+        spool.save(done)
+        again = JobSpool(str(tmp_path / "spool"))
+        pending = again.recover()
+        # submission order; running demoted to pending; terminal untouched
+        assert [j.id for j in pending] == [a.id, b.id]
+        assert all(j.state == "pending" for j in pending)
+        assert again.get(done.id).state == "done"
+        assert again.get("nonexistent") is None
+
+
+def test_warm_pool_failed_compile_is_not_reported_warm(monkeypatch):
+    """A failed warm compile must neither skip the remaining batch sizes
+    nor leave the shape claiming warmth its executables don't have."""
+    from iterative_cleaner_tpu.parallel import sharded
+    from iterative_cleaner_tpu.service.pool import WarmPool
+    from iterative_cleaner_tpu.utils import compile_cache
+
+    compile_cache._seen.clear()
+    mesh = make_mesh(8, devices=jax.devices("cpu"))
+    pool = WarmPool(CleanConfig(backend="jax", max_iter=2), mesh, 4)
+    seen_sizes = []
+
+    def flaky(Db, w0b, cfg, mesh):
+        seen_sizes.append(Db.shape[0])
+        if Db.shape[0] == 2:
+            raise RuntimeError("transient RPC error")
+
+    monkeypatch.setattr(sharded, "sharded_clean", flaky)
+    assert pool.warm_shape((4, 16, 64)) == 2   # sizes 1, 4 ok; 2 failed
+    assert seen_sizes == [1, 2, 4]             # failure did not abort 4
+    assert not pool.is_warm((4, 16, 64))       # size 2 honestly missing
+    monkeypatch.setattr(sharded, "sharded_clean",
+                        lambda *a, **kw: seen_sizes.append("retry"))
+    assert pool.warm_shape((4, 16, 64)) == 1   # only the forgotten size
+    assert pool.is_warm((4, 16, 64))
+
+
+def test_daemon_end_to_end_mixed_shapes(tmp_path):
+    """3 jobs of 2 distinct shapes + 1 corrupt archive over real HTTP:
+    bucketed dispatch, oracle-identical masks, per-job failure isolation,
+    live /healthz and /metrics."""
+    a0 = _write(tmp_path, "a0.npz", nsub=8, seed=50)
+    a1 = _write(tmp_path, "a1.npz", nsub=8, seed=51)
+    b0 = _write(tmp_path, "b0.npz", nsub=4, seed=52)
+    corrupt = str(tmp_path / "corrupt.npz")
+    with open(corrupt, "wb") as fh:
+        fh.write(b"not an archive")
+    before = tracing.counters_snapshot()
+    svc = _start(tmp_path, deadline_s=1.0)
+    try:
+        jobs = {p: _post_job(svc, p) for p in (a0, a1, b0, corrupt)}
+        assert all(j["state"] == "pending" for j in jobs.values())
+        assert svc.drain(180)
+        for p in (a0, a1, b0):
+            got = _get(svc, f"/jobs/{jobs[p]['id']}")
+            assert got["state"] == "done" and got["served_by"] == "sharded"
+            out = NpzIO().load(got["out_path"])
+            np.testing.assert_array_equal(out.weights, _oracle_weights(p))
+        bad = _get(svc, f"/jobs/{jobs[corrupt]['id']}")
+        assert bad["state"] == "error" and "load failed" in bad["error"]
+
+        health = _get(svc, "/healthz")
+        assert health["status"] == "ok" and health["backend"] == "jax"
+        assert health["open_jobs"] == 0
+        metrics = _get(svc, "/metrics")
+        d = lambda k: metrics.get(k, 0) - before.get(k, 0)
+        assert d("service_jobs_submitted") == 4
+        assert d("service_jobs_done") == 3 and d("service_jobs_error") == 1
+        # the two same-shape jobs filled one dp slice (cap 2 on the 8-device
+        # mesh); the odd shape went out on the deadline path
+        assert d("service_buckets_dispatched") >= 2
+        assert d("service_load_n") >= 3 and metrics["service_dispatch_s"] > 0
+        assert _get(svc, "/jobs/nope", expect_error=True) == 404
+        assert _get(svc, "/nothing", expect_error=True) == 404
+        # malformed bodies (non-dict JSON included) get a 400, not a
+        # dropped socket
+        for body in (b"[]", b"5", b"{}", b"not json"):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{svc.port}/jobs", data=body)
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(req, timeout=30)
+            assert exc_info.value.code == 400
+        # terminal jobs are evicted from the in-memory index (bounded
+        # daemon memory) but stay fully readable through the spool
+        with svc._jobs_lock:
+            assert svc._jobs == {}
+    finally:
+        svc.stop()
+
+
+def test_warm_shape_dispatches_with_zero_new_compiles(tmp_path, compile_events):
+    """The warm pool precompiles every batch size the scheduler can emit
+    for a declared shape, so submissions of that shape — first AND second —
+    trigger no backend compile at all (the test_precompile evidence
+    pattern, applied to the serving path)."""
+    p1 = _write(tmp_path, "w1.npz", nsub=4, seed=60)
+    p2 = _write(tmp_path, "w2.npz", nsub=4, seed=61)
+    svc = _start(tmp_path, warm_shapes=((4, 16, 64),))
+    try:
+        assert backend_compiles(compile_events)  # the warm did compile
+        assert svc.pool.is_warm((4, 16, 64))
+        compile_events.clear()
+        job1 = _post_job(svc, p1)
+        assert svc.drain(120)
+        job1 = _get(svc, f"/jobs/{job1['id']}")
+        assert job1["state"] == "done" and job1["served_by"] == "sharded"
+        assert backend_compiles(compile_events) == []
+        job2 = _post_job(svc, p2)
+        assert svc.drain(120)
+        assert _get(svc, f"/jobs/{job2['id']}")["state"] == "done"
+        assert backend_compiles(compile_events) == []
+        np.testing.assert_array_equal(
+            NpzIO().load(job1["out_path"]).weights, _oracle_weights(p1))
+    finally:
+        svc.stop()
+
+
+def test_second_daemon_on_one_spool_is_refused(tmp_path):
+    """Two daemons on one spool would sweep each other's temps and
+    re-dispatch each other's running jobs; the flock refuses the second
+    before it touches anything, and stop() releases it for a restart."""
+    svc = _start(tmp_path)
+    try:
+        dup = CleaningService(ServeConfig(
+            spool_dir=str(tmp_path / "spool"), port=0, quiet=True,
+            clean=CleanConfig(backend="numpy")))
+        with pytest.raises(RuntimeError, match="already served"):
+            dup.start()
+    finally:
+        svc.stop()
+    # the lock died with the first service; a restart acquires it cleanly
+    svc2 = _start(tmp_path)
+    svc2.stop()
+
+
+def test_failed_start_releases_the_flock(tmp_path):
+    """A mid-start failure (port already bound) must clean up: no leaked
+    flock, so a corrected retry on the same spool starts fine."""
+    import socket
+
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    bad = CleaningService(ServeConfig(
+        spool_dir=str(tmp_path / "spool"), port=port, quiet=True,
+        clean=CleanConfig(backend="numpy")))
+    with pytest.raises(OSError):
+        bad.start()
+    blocker.close()
+    svc = _start(tmp_path)  # flock was released by the failed start
+    svc.stop()
+
+
+def test_spool_resume_after_restart(tmp_path):
+    """Jobs accepted by a daemon that died (one still 'running' mid-
+    dispatch) are replayed to completion by the next daemon on the same
+    spool."""
+    p1 = _write(tmp_path, "r1.npz", nsub=4, seed=70)
+    p2 = _write(tmp_path, "r2.npz", nsub=4, seed=71)
+    spool = JobSpool(str(tmp_path / "spool"))
+    j1 = spool.create(p1)
+    j2 = spool.create(p2)
+    j2.state = "running"   # the previous life died mid-dispatch
+    spool.save(j2)
+    before = tracing.counters_snapshot()
+    svc = _start(tmp_path)
+    try:
+        assert svc.drain(120)
+        for j, p in ((j1, p1), (j2, p2)):
+            got = _get(svc, f"/jobs/{j.id}")
+            assert got["state"] == "done"
+            np.testing.assert_array_equal(
+                NpzIO().load(got["out_path"]).weights, _oracle_weights(p))
+        after = tracing.counters_snapshot()
+        assert after.get("service_jobs_recovered", 0) - before.get(
+            "service_jobs_recovered", 0) == 2
+    finally:
+        svc.stop()
+
+
+def test_dispatch_failure_degrades_to_oracle_and_demotes(tmp_path, monkeypatch):
+    """The failure ladder: a bucket dispatch that keeps throwing is retried,
+    then every job in it degrades to the numpy oracle individually — and
+    repeated bucket failures demote the whole service."""
+    from iterative_cleaner_tpu.service.worker import DispatchWorker
+
+    def boom(self, entries):
+        raise RuntimeError("synthetic backend failure")
+
+    monkeypatch.setattr(DispatchWorker, "_dispatch_sharded", boom)
+    p1 = _write(tmp_path, "f1.npz", nsub=4, seed=80)
+    before = tracing.counters_snapshot()
+    svc = _start(tmp_path, dispatch_retries=1, demote_after=1)
+    try:
+        job = _post_job(svc, p1)
+        assert svc.drain(120)
+        got = _get(svc, f"/jobs/{job['id']}")
+        assert got["state"] == "done"
+        assert got["served_by"] == "oracle-fallback"
+        assert got["attempts"] == 2  # first try + one retry
+        np.testing.assert_array_equal(
+            NpzIO().load(got["out_path"]).weights, _oracle_weights(p1))
+        # demote_after=1: the service is now oracle-wide
+        assert _get(svc, "/healthz")["backend"] == "numpy"
+        after = tracing.counters_snapshot()
+        for key in ("service_dispatch_retries", "service_oracle_fallbacks",
+                    "service_backend_demotions"):
+            assert after.get(key, 0) > before.get(key, 0)
+    finally:
+        svc.stop()
+
+
+def test_admission_cap_returns_503_and_root_refuses_outside_paths(tmp_path):
+    """Backpressure and the --root trust boundary: beyond the open-job cap
+    POST gets 503 + Retry-After; a path outside --root gets 400."""
+    inside = _write(tmp_path, "in.npz", nsub=4, seed=90)
+    svc = _start(tmp_path, max_open_jobs=1, root=str(tmp_path),
+                 deadline_s=30.0)  # park the job so it stays open
+    try:
+        first = _post_job(svc, inside)
+        assert first["state"] == "pending"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc.port}/jobs",
+            data=json.dumps({"path": inside}).encode())
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=30)
+        assert exc_info.value.code == 503
+        assert exc_info.value.headers["Retry-After"] == "5"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc.port}/jobs",
+            data=json.dumps({"path": "/etc/passwd"}).encode())
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=30)
+        assert exc_info.value.code == 400
+        # drain the parked job: wait until it is decoded into its bucket,
+        # then force the deadline
+        deadline = time.time() + 60
+        while svc.scheduler.pending_count() == 0 and time.time() < deadline:
+            time.sleep(0.02)
+        svc.scheduler.tick(now=time.monotonic() + 60)
+        assert svc.drain(120)
+    finally:
+        svc.stop()
+
+
+def test_auto_stream_note_respects_quiet(tmp_path, monkeypatch, capsys):
+    from iterative_cleaner_tpu import driver
+
+    p = _write(tmp_path, "qn.npz", nsub=4, seed=91)
+    monkeypatch.setenv("ICT_STREAM_THRESHOLD_BYTES", "1")
+    cfg = CleanConfig(backend="jax", sharded_batch=True, quiet=True)
+    assert driver._auto_stream([p], cfg) is True
+    assert capsys.readouterr().err == ""
+    assert driver._auto_stream([p], cfg.replace(quiet=False)) is True
+    assert "streaming dispatcher" in capsys.readouterr().err
+
+
+def test_serve_token_yields_to_a_real_file_named_serve(tmp_path, monkeypatch):
+    """A file literally named 'serve' in cwd keeps the reference semantics
+    (positionals are archives); the daemon needs ict-serve or a clean cwd."""
+    from iterative_cleaner_tpu.cli import main
+    from iterative_cleaner_tpu.service import daemon
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("ICT_NO_COMPILE_CACHE", "1")  # keep process config
+    (tmp_path / "serve").write_bytes(b"not an archive")
+    monkeypatch.setattr(daemon, "serve_main",
+                        lambda argv: pytest.fail("daemon must not run"))
+    # routes to the cleaner, which fails to load the garbage file -> rc 1
+    assert main(["serve", "-q", "-l"]) == 1
+
+
+def test_cli_dispatches_serve_subcommand(monkeypatch):
+    from iterative_cleaner_tpu.cli import main
+    from iterative_cleaner_tpu.service import daemon
+
+    seen = {}
+
+    def fake_serve(argv):
+        seen["argv"] = argv
+        return 7
+
+    monkeypatch.setattr(daemon, "serve_main", fake_serve)
+    assert main(["serve", "--port", "0"]) == 7
+    assert seen["argv"] == ["--port", "0"]
+
+
+def test_serve_parser_and_warm_shapes():
+    from iterative_cleaner_tpu.service.daemon import (
+        build_serve_parser,
+        parse_warm_shapes,
+        serve_config_from_args,
+    )
+
+    args = build_serve_parser().parse_args(
+        ["--warm", "8x16x64", "--warm", "4x16x64", "-m", "3", "--port", "0"])
+    cfg = serve_config_from_args(args)
+    assert cfg.warm_shapes == ((8, 16, 64), (4, 16, 64))
+    assert cfg.clean.max_iter == 3 and cfg.clean.backend == "jax"
+    with pytest.raises(ValueError):
+        parse_warm_shapes(["8x16"])
+    # ambiguous negatives are rejected at parse time (one-line error, not
+    # a daemon that refuses every submission forever)
+    for bad in (["--max_open_jobs", "-1"], ["--bucket_cap", "-1"]):
+        with pytest.raises(ValueError):
+            serve_config_from_args(build_serve_parser().parse_args(bad))
+
+
+def test_root_resolves_symlinks_and_revalidates_on_replay(tmp_path):
+    """--root is checked against the RESOLVED path, which is also what the
+    job stores (no admission/load TOCTOU), and replayed manifests are
+    re-validated against the current root."""
+    data = tmp_path / "data"
+    data.mkdir()
+    outside = _write(tmp_path, "outside.npz", nsub=4, seed=95)
+    (data / "link.npz").symlink_to(outside)
+    svc = _start(tmp_path, root=str(data))
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc.port}/jobs",
+            data=json.dumps({"path": str(data / "link.npz")}).encode())
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=30)
+        assert exc_info.value.code == 400  # resolves outside the root
+    finally:
+        svc.stop()
+    # restart replay: a spooled manifest pointing outside the (new) root
+    # fails its job instead of being read
+    spool = JobSpool(str(tmp_path / "spool"))
+    j = spool.create(outside)
+    svc2 = _start(tmp_path, root=str(data))
+    try:
+        assert svc2.drain(60)
+        replayed = svc2.job(j.id)
+        assert replayed.state == "error" and "outside --root" in replayed.error
+    finally:
+        svc2.stop()
