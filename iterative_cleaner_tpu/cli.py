@@ -84,11 +84,33 @@ def build_parser() -> argparse.ArgumentParser:
                    help="skip archives whose cleaned output already exists "
                         "(rerun an interrupted batch; default naming mode only)")
     p.add_argument("--stream", action="store_true",
-                   help="with --sharded_batch: dispatch each same-shape "
-                        "bucket as soon as its archives are decoded, "
-                        "overlapping host I/O with device compute (bounded "
-                        "host memory; default loads the whole directory "
-                        "before dispatching)")
+                   help="with --sharded_batch: the bounded-host-residency "
+                        "batch LOADER for directories of complete archives "
+                        "— dispatch each same-shape bucket as soon as its "
+                        "archives are decoded, overlapping host I/O with "
+                        "device compute (default loads the whole directory "
+                        "before dispatching).  Not the real-time online "
+                        "mode; for archives still being WRITTEN see "
+                        "--follow")
+    p.add_argument("--follow", action="store_true",
+                   help="online mode: tail each archive as it GROWS on disk "
+                        "(an observatory-side writer appending subint "
+                        "blocks), emit provisional zap alerts within one "
+                        "poll of each block landing, and at end-of-stream "
+                        "(<archive>.eos sentinel, or no growth for "
+                        "--follow_timeout) run the canonical clean on the "
+                        "completed file — the final mask is the ordinary "
+                        "offline result; the alerts are advisory "
+                        "(docs/SERVING.md)")
+    p.add_argument("--follow_poll", type=float, default=1.0, metavar="S",
+                   help="--follow: seconds between growth polls (default 1)")
+    p.add_argument("--follow_timeout", type=float, default=30.0, metavar="S",
+                   help="--follow: end-of-stream after this many seconds "
+                        "without growth when no .eos sentinel appears "
+                        "(default 30)")
+    p.add_argument("--alert_iters", type=int, default=2, metavar="N",
+                   help="--follow: provisional clean-pass iterations per "
+                        "ingested block (default 2)")
     p.add_argument("--no_auto_shard", action="store_true",
                    help="jax: never shard an oversized cube over the device "
                         "mesh (default: cubes whose working set exceeds one "
@@ -184,6 +206,12 @@ def main(argv: list[str] | None = None) -> int:
     try:
         cfg = config_from_args(args)
         sweep_pairs = parse_sweep_pairs(args.sweep) if args.sweep else None
+        if args.follow and (args.sharded_batch or args.sweep):
+            raise ValueError("--follow tails growing single archives and "
+                             "cannot combine with --sharded_batch/--sweep")
+        if args.follow and args.alert_iters < 1:
+            raise ValueError(
+                f"--alert_iters must be >= 1, got {args.alert_iters}")
     except ValueError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
@@ -208,6 +236,13 @@ def main(argv: list[str] | None = None) -> int:
         from iterative_cleaner_tpu.driver import run_sweep
 
         reports = run_sweep(args.archive, cfg, sweep_pairs)
+    elif args.follow:
+        from iterative_cleaner_tpu.driver import run_follow
+
+        reports = run_follow(
+            args.archive, cfg, poll_s=args.follow_poll,
+            idle_timeout_s=args.follow_timeout,
+            alert_iters=args.alert_iters)
     else:
         from iterative_cleaner_tpu.driver import run
 

@@ -62,6 +62,84 @@ class CleanResult:
 ProgressFn = Callable[[IterationInfo], None]
 
 
+@dataclass
+class LoopState:
+    """Resumable state of the canonical convergence loop.
+
+    Everything the stepwise iteration carries between ``backend.step`` calls
+    — the weight history (cycle detection, §8.L10), per-loop records, and
+    the stopping bookkeeping — extracted so the batch path (clean_cube) and
+    the online streaming passes (online/session.py) share ONE loop
+    implementation instead of two that could drift.  ``start(w)`` seeds the
+    pre-loop weights into the history exactly as the reference seeds
+    ``test_weights`` (iterative_cleaner.py:77-78); the online pass seeds the
+    previous provisional mask instead of w0, which only shapes the first
+    template (stats always run against the backend's frozen w0, §8.L11).
+    """
+
+    w_prev: np.ndarray
+    history: list[np.ndarray]
+    infos: list[IterationInfo] = field(default_factory=list)
+    test_results: np.ndarray | None = None
+    loops: int = 0
+    converged: bool = False
+
+    @classmethod
+    def start(cls, w_init: np.ndarray) -> "LoopState":
+        w = np.asarray(w_init, dtype=np.float32)
+        return cls(w_prev=w, history=[w.copy()])
+
+    def advance(self, backend, progress: ProgressFn | None = None,
+                timer=None) -> bool:
+        """Run one iteration; returns True when the loop should stop
+        (the new mask reproduced any mask in the history)."""
+        x = len(self.infos) + 1
+        test_results, new_w = backend.step(self.w_prev)
+        self.test_results = np.asarray(test_results)
+        new_w = np.asarray(new_w)
+
+        info = _iteration_info(x, self.history[-1], new_w,
+                               duration_s=timer.lap() if timer else 0.0)
+        self.infos.append(info)
+        if progress is not None:
+            progress(info)
+
+        # Full-history cycle detection, pre-loop weights included (§8.L10).
+        stop = any(np.array_equal(new_w, old) for old in self.history)
+        self.history.append(new_w)
+        self.w_prev = new_w
+        if stop:
+            self.loops = x
+            self.converged = True
+        return stop
+
+    def run(self, backend, max_iter: int,
+            progress: ProgressFn | None = None, timed: bool = True) -> None:
+        """Advance until convergence or ``max_iter`` TOTAL iterations (a
+        resumed state counts the iterations it already ran)."""
+        from iterative_cleaner_tpu.utils.tracing import StepTimer
+
+        timer = StepTimer() if timed else None
+        while len(self.infos) < max_iter:
+            if self.advance(backend, progress=progress, timer=timer):
+                break
+        if not self.converged:
+            self.loops = max_iter
+
+    def result(self, residual: np.ndarray | None = None,
+               timed: bool = False) -> CleanResult:
+        return CleanResult(
+            weights=self.history[-1].copy(),
+            test_results=self.test_results,
+            loops=self.loops,
+            converged=self.converged,
+            iterations=self.infos,
+            history=self.history,
+            residual=residual,
+            timed=timed,
+        )
+
+
 def _iteration_info(
     index: int, prev_w: np.ndarray, new_w: np.ndarray, duration_s: float = 0.0
 ) -> IterationInfo:
@@ -276,52 +354,15 @@ def clean_cube(
             D, w0, cfg, block=chunk_block, keep_residual=want_residual)
     else:
         backend = make_backend(D, w0, cfg)
-    w0 = np.asarray(w0, dtype=np.float32)
-
-    history: list[np.ndarray] = [w0.copy()]
-    w_prev = w0
-    infos: list[IterationInfo] = []
-    test_results = None
-    loops = cfg.max_iter
-    converged = False
-
-    from iterative_cleaner_tpu.utils.tracing import StepTimer
-
-    timer = StepTimer()
-    for x in range(1, cfg.max_iter + 1):
-        test_results, new_w = backend.step(w_prev)
-        test_results = np.asarray(test_results)
-        new_w = np.asarray(new_w)
-
-        info = _iteration_info(x, history[-1], new_w, duration_s=timer.lap())
-        infos.append(info)
-        if progress is not None:
-            progress(info)
-
-        # Full-history cycle detection, pre-loop weights included (§8.L10).
-        stop = any(np.array_equal(new_w, old) for old in history)
-        history.append(new_w)
-        w_prev = new_w
-        if stop:
-            loops = x
-            converged = True
-            break
+    state = LoopState.start(w0)
+    state.run(backend, cfg.max_iter, progress=progress)
 
     residual = None
     if want_residual:
         r = backend.residual()
         residual = None if r is None else np.asarray(r)
 
-    return CleanResult(
-        weights=history[-1].copy(),
-        test_results=test_results,
-        loops=loops,
-        converged=converged,
-        iterations=infos,
-        history=history,
-        residual=residual,
-        timed=True,
-    )
+    return state.result(residual=residual, timed=True)
 
 
 def find_bad_parts(

@@ -381,6 +381,37 @@ def run_sharded_batch(
     return [reports[i] for i in range(len(items))]
 
 
+def run_follow(
+    paths: list[str],
+    cfg: CleanConfig,
+    poll_s: float = 1.0,
+    idle_timeout_s: float = 30.0,
+    alert_iters: int = 2,
+    log_dir: str = ".",
+    sleep=None,
+) -> list[ArchiveReport]:
+    """--follow: tail each growing archive through the online subsystem
+    (online/follow.py), sequentially, with the sequential driver's
+    per-archive failure isolation — a dead stream must not kill the
+    observation's sibling follows.  ``sleep`` is the tail loop's injectable
+    wait (tests drive growth deterministically through it)."""
+    from iterative_cleaner_tpu.online.follow import follow_archive
+
+    invocation = list(paths)
+    reports = []
+    for path in paths:
+        try:
+            reports.append(follow_archive(
+                path, cfg, poll_s=poll_s, idle_timeout_s=idle_timeout_s,
+                alert_iters=alert_iters, log_dir=log_dir,
+                all_paths=invocation, sleep=sleep))
+        except Exception as exc:  # noqa: BLE001 — isolate, report, continue
+            reports.append(ArchiveReport(path=path, out_path=None,
+                                         error=str(exc)))
+            print(f"ERROR following {path}: {exc}", file=sys.stderr)
+    return reports
+
+
 def write_report(
     reports: list[ArchiveReport], path: str, cfg: CleanConfig | None = None
 ) -> None:

@@ -10,7 +10,7 @@ directly.  Backends: NPZ (canonical, hermetic; :mod:`..io.npz`) and psrchive
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, replace
 from typing import Protocol
 
 import numpy as np

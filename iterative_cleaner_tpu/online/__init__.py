@@ -1,0 +1,26 @@
+"""Real-time streaming-ingest subsystem (ict-online).
+
+Subint blocks arrive incrementally — over the daemon's session API
+(service/sessions.py, docs/SERVING.md) or the CLI's ``--follow`` file tail
+(online/follow.py) — a resident per-session :class:`CleanState` grows by
+amortized doubling, every block triggers a bounded provisional clean pass
+with zap alerts (advisory, latency-first), and end-of-stream runs the
+canonical pipeline on the completed cube so the authoritative mask stays
+bit-identical to the numpy oracle by construction (online/finalize.py).
+"""
+
+from iterative_cleaner_tpu.online.finalize import (
+    FinalizedSession,
+    finalize_session,
+)
+from iterative_cleaner_tpu.online.session import OnlineSession, ZapAlert
+from iterative_cleaner_tpu.online.state import CleanState, SessionMeta
+
+__all__ = [
+    "CleanState",
+    "FinalizedSession",
+    "OnlineSession",
+    "SessionMeta",
+    "ZapAlert",
+    "finalize_session",
+]

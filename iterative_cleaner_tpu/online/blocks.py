@@ -1,0 +1,42 @@
+"""Block wire format — one codec for the HTTP body and the session spool.
+
+A block is two arrays, ``data`` (bsub, npol, nchan, nbin) and ``weights``
+(bsub, nchan), carried as an in-memory NPZ (``np.savez_compressed`` into a
+buffer): the same hermetic container the archive backend already uses, so
+clients build uploads with nothing but numpy, and the daemon persists the
+received bytes VERBATIM as the session's replay log — decode validates the
+payload once and the spooled copy replays through the identical path after
+a restart.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+#: Upload clamp for one block body (the service applies it to
+#: Content-Length): a 256 MB f32 block is ~1M profiles of 64 bins — far
+#: beyond any per-block observatory cadence — while an unbounded read
+#: would let one client buffer the daemon out of host RAM.
+MAX_BLOCK_BYTES = 256 << 20
+
+
+def encode_block(data: np.ndarray, weights: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.savez_compressed(buf, data=np.asarray(data, np.float32),
+                        weights=np.asarray(weights, np.float32))
+    return buf.getvalue()
+
+
+def decode_block(payload: bytes) -> tuple[np.ndarray, np.ndarray]:
+    """Bytes → (data, weights); raises ValueError on anything malformed
+    (the API maps that to a 400, never a dropped socket)."""
+    try:
+        with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+            return (np.asarray(z["data"], np.float32),
+                    np.asarray(z["weights"], np.float32))
+    except KeyError as exc:
+        raise ValueError(f"block payload missing array {exc}") from None
+    except Exception as exc:  # noqa: BLE001 — zipfile/format errors vary
+        raise ValueError(f"undecodable block payload: {exc}") from None

@@ -1,0 +1,76 @@
+"""End-of-stream finalization: the canonical clean on the completed cube.
+
+This is deliberately NOT an incremental algorithm.  The provisional passes
+exist for alert latency; the authoritative mask comes from running the
+ordinary offline pipeline (:class:`..models.surgical.SurgicalCleaner` —
+preprocess, clean_cube, bad-parts sweep, output policy) on the assembled
+archive, so the streaming subsystem inherits the repo's core invariant —
+**final masks bit-identical to the numpy oracle** — by construction rather
+than by a parallel proof about incremental state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from iterative_cleaner_tpu.io.base import Archive
+from iterative_cleaner_tpu.models.surgical import SurgicalCleaner, SurgicalOutput
+
+
+@dataclass
+class FinalizedSession:
+    archive: Archive               # the assembled completed cube
+    output: SurgicalOutput         # canonical pipeline output
+    n_provisional_zaps: int        # advisory mask's zap count at EOS
+    n_final_zaps: int              # authoritative mask's zap count
+    provisional_mismatches: int    # profiles where the two disagree
+
+    @property
+    def result(self):
+        return self.output.result
+
+    def to_dict(self) -> dict:
+        res = self.output.result
+        return {
+            "loops": int(res.loops),
+            "converged": bool(res.converged),
+            "rfi_frac": float(res.rfi_frac),
+            "nsub": int(self.archive.nsub),
+            "n_provisional_zaps": int(self.n_provisional_zaps),
+            "n_final_zaps": int(self.n_final_zaps),
+            "provisional_mismatches": int(self.provisional_mismatches),
+        }
+
+
+def finalize_session(session, archive: Archive | None = None,
+                     progress=None) -> FinalizedSession:
+    """Run the canonical pipeline over the session's completed cube.
+
+    ``archive`` overrides the assembled slab — the --follow tail passes the
+    final on-disk archive so the authoritative clean sees byte-for-byte what
+    any offline rerun of the same file would (metadata drift included).
+    """
+    if session.state.nsub == 0:
+        raise ValueError("cannot finalize a session with no blocks")
+    if archive is None:
+        archive = session.state.assemble_archive()
+    out = SurgicalCleaner(session.cfg).clean(archive, progress=progress)
+
+    # Provisional-accuracy accounting — how good the advisory mask was at
+    # the moment the stream ended (reported, never load-bearing).  Compare
+    # against the pre-sweep iterative mask: the provisional pass never runs
+    # the bad-parts sweep.
+    prov = session.state.prov_w
+    final_w = np.asarray(out.result.weights)
+    mismatches = (
+        int(np.sum((prov == 0) != (final_w == 0)))
+        if prov.shape == final_w.shape else -1)
+    return FinalizedSession(
+        archive=archive,
+        output=out,
+        n_provisional_zaps=int((prov == 0).sum()),
+        n_final_zaps=int((final_w == 0).sum()),
+        provisional_mismatches=mismatches,
+    )

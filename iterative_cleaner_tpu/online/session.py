@@ -1,0 +1,215 @@
+"""One streaming cleaning session: blocks in, provisional zap alerts out.
+
+After every ingested block the session runs a BOUNDED incremental clean
+pass over everything that has arrived (``alert_iters`` iterations, default
+2) and reports which (subint, channel) profiles it would newly zap — the
+operator's within-seconds RFI alarm.  The pass is the canonical loop
+(:class:`..core.cleaner.LoopState` — the exact implementation clean_cube
+runs), warm-started from the previous block's provisional mask so the
+template starts near the fixed point, over the canonical per-iteration
+kernels:
+
+- jax backend: :class:`..parallel.chunked.ChunkedJaxCleaner` with a FIXED
+  subint block size, so the executable set stays bounded while the session
+  grows — a fresh whole-cube jit per arrived block would compile a new
+  executable per distinct nsub and burn the process's ~70-executable budget
+  (utils/compile_cache.py) in one observation;
+- numpy backend: the oracle, one pass, no compilation story.
+
+**Provisional masks are advisory, never authoritative** (docs/PARITY.md):
+they exist for alert latency, and a session only produces its real mask at
+:meth:`finalize`, which runs the canonical pipeline on the completed cube —
+bit-identical to the numpy oracle by the repo's core invariant, because it
+IS the normal offline path on the assembled archive.
+
+Latency per block lands in the process-global phase counters
+(``online_block_s/_n/_max_s``, ``online_pass_*`` — utils/tracing.py), which
+the serving daemon's ``/metrics`` reports.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import LoopState
+from iterative_cleaner_tpu.online.state import CleanState, SessionMeta
+from iterative_cleaner_tpu.utils import tracing
+
+#: Alert payloads list at most this many newly-zapped (subint, channel)
+#: pairs; beyond it only the count is reported (``truncated: true``) — an
+#: alert is an alarm, not a mask transport.
+MAX_ALERT_PAIRS = 256
+
+#: Default bounded-pass iteration count.  Two is the warm-start sweet spot:
+#: iteration 1 reacts to the new block through the carried template,
+#: iteration 2 settles the template it perturbed; the canonical fixed point
+#: is finalize's job.
+DEFAULT_ALERT_ITERS = 2
+
+
+@dataclass
+class ZapAlert:
+    """One block's provisional verdict."""
+
+    block_index: int               # 0-based arrival number
+    subint_lo: int                 # the block's first subint
+    subint_hi: int                 # one past its last subint
+    nsub_total: int                # session subints after this block
+    n_new_zaps: int                # profiles newly zapped by this pass
+    new_zaps: list[list[int]] = field(default_factory=list)
+    truncated: bool = False        # new_zaps capped at MAX_ALERT_PAIRS
+    provisional_rfi_frac: float = 0.0
+    pass_iterations: int = 0
+    pass_converged: bool = False
+    latency_s: float = 0.0         # ingest+pass wall-clock for this block
+
+    def to_dict(self) -> dict:
+        from dataclasses import asdict
+
+        return asdict(self)
+
+
+class OnlineSession:
+    """Accepts subint blocks incrementally; see the module docstring."""
+
+    def __init__(
+        self,
+        meta: SessionMeta,
+        cfg: CleanConfig | None = None,
+        alert_iters: int = DEFAULT_ALERT_ITERS,
+        pass_block: int = 0,
+    ) -> None:
+        self.meta = meta
+        self.cfg = cfg or CleanConfig(backend="jax")
+        if alert_iters < 1:
+            raise ValueError(f"alert_iters must be >= 1, got {alert_iters}")
+        self.alert_iters = int(alert_iters)
+        # Fixed chunked-pass slab size (0 = derive from the first block).
+        self._pass_block = int(pass_block)
+        self.state = CleanState(meta)
+        self.blocks_ingested = 0
+        self.alerts: list[ZapAlert] = []
+        self.finalized = False
+
+    # --- ingest ---
+
+    def _append(self, data: np.ndarray, weights: np.ndarray) -> int:
+        lo = self.state.append_block(data, weights)
+        if not self._pass_block:
+            # Pow2 ceiling of the first block: most passes then run on
+            # whole slabs of this one shape (plus at most pass_block
+            # distinct remainder shapes over the session's life).
+            self._pass_block = 1 << max(0, (self.state.nsub - lo) - 1
+                                        ).bit_length()
+        return lo
+
+    def ingest(self, data: np.ndarray, weights: np.ndarray) -> ZapAlert:
+        """Append one block, run the bounded provisional pass, return the
+        alert.  Raises ValueError on shape mismatches and on a finalized
+        session.  Exception-safe: a pass that dies (e.g. a backend runtime
+        error) rolls the append back, so the session state never diverges
+        from what the caller believes was accepted — the block can simply
+        be resubmitted."""
+        if self.finalized:
+            raise ValueError("session already finalized")
+        with tracing.phase("online_block"):
+            import time
+
+            t0 = time.perf_counter()
+            lo = self._append(data, weights)
+            hi = self.state.nsub
+            try:
+                with tracing.phase("online_pass"):
+                    alert = self._provisional_pass(lo, hi)
+            except Exception:
+                # Roll the append back (rows beyond nsub are inert; the
+                # capacity stays for the retry).  prov_w was not touched —
+                # _provisional_pass only assigns it on success.
+                self.state.nsub = lo
+                raise
+            alert.latency_s = time.perf_counter() - t0
+        tracing.count("online_blocks_ingested")
+        tracing.count("online_zap_alerts", alert.n_new_zaps)
+        self.blocks_ingested += 1
+        self.alerts.append(alert)
+        return alert
+
+    def replay_block(self, data: np.ndarray, weights: np.ndarray) -> None:
+        """Spool replay (restart resume): append WITHOUT the per-block
+        provisional pass — the alerts were already emitted in the previous
+        daemon life and provisional state is advisory, so a restart costs
+        O(slab copy), not O(blocks × device pass).  The first live ingest
+        after a replay seeds its pass from the original weights (prov_w is
+        empty), exactly like a fresh session's first pass over the full
+        accumulated cube."""
+        if self.finalized:
+            raise ValueError("session already finalized")
+        self._append(data, weights)
+        self.blocks_ingested += 1
+
+    def _backend(self, D: np.ndarray, w0: np.ndarray):
+        if self.cfg.backend != "jax":
+            from iterative_cleaner_tpu.backends.numpy_backend import (
+                NumpyCleaner,
+            )
+
+            return NumpyCleaner(D, w0, self.cfg)
+        from iterative_cleaner_tpu.parallel.chunked import ChunkedJaxCleaner
+        from iterative_cleaner_tpu.utils.compile_cache import (
+            note_compiled_shape,
+        )
+
+        # Same executable accounting as clean_cube's chunked branch (same
+        # key layout, so a CLI chunked run of this slab shape shares the
+        # budget entry): the step loop's slab executables, full + remainder.
+        nsub, nchan, nbin = D.shape
+        block = min(self._pass_block, nsub)
+        fp = ("chunked", False, self.cfg.x64, False,
+              self.cfg.incremental_template, tuple(self.cfg.pulse_region))
+        note_compiled_shape((block, nchan, nbin, *fp))
+        if nsub > block and nsub % block:
+            note_compiled_shape((nsub % block, nchan, nbin, *fp))
+        return ChunkedJaxCleaner(D, w0, self.cfg, block=block)
+
+    def _provisional_pass(self, lo: int, hi: int) -> ZapAlert:
+        D, w0 = self.state.provisional_inputs()
+        # Warm-start seed: the previous provisional mask, extended with the
+        # new block's own original weights.  The seed only shapes the first
+        # template (stats run against the frozen w0 — §8.L11), so a bad
+        # earlier provisional can always be un-flagged by a later pass.
+        seed = np.concatenate([self.state.prov_w, w0[lo:]], axis=0) \
+            if self.state.prov_w.size else w0.copy()
+        loop = LoopState.start(seed)
+        loop.run(self._backend(D, w0), self.alert_iters, timed=False)
+        new_prov = loop.history[-1]
+
+        newly = np.argwhere((new_prov == 0) & (seed != 0))
+        pairs = newly[:MAX_ALERT_PAIRS].tolist()
+        alert = ZapAlert(
+            block_index=self.blocks_ingested,
+            subint_lo=lo,
+            subint_hi=hi,
+            nsub_total=hi,
+            n_new_zaps=int(len(newly)),
+            new_zaps=pairs,
+            truncated=len(newly) > MAX_ALERT_PAIRS,
+            provisional_rfi_frac=float((new_prov == 0).mean()),
+            pass_iterations=len(loop.infos),
+            pass_converged=loop.converged,
+        )
+        self.state.prov_w = new_prov
+        return alert
+
+    # --- end of stream ---
+
+    def finalize(self, progress=None):
+        """Canonical end-of-stream clean (online/finalize.py); marks the
+        session closed.  Returns the FinalizedSession."""
+        from iterative_cleaner_tpu.online.finalize import finalize_session
+
+        out = finalize_session(self, progress=progress)
+        self.finalized = True
+        return out

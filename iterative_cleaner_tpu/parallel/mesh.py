@@ -21,7 +21,6 @@ sharding a single giant cube across hosts) requires
 
 from __future__ import annotations
 
-import math
 
 import jax
 import numpy as np

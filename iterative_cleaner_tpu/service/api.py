@@ -1,33 +1,71 @@
 """JSON HTTP surface over stdlib ``http.server`` — zero new dependencies.
 
-Endpoints (all JSON; full reference in docs/SERVING.md):
+Endpoints (JSON unless noted; full reference in docs/SERVING.md):
 
 - ``POST /jobs``            ``{"path": "/abs/archive.npz"}`` -> 202 + job
 - ``GET  /jobs/<id>``       job manifest (state machine in service/jobs.py)
+- ``POST /sessions``        open a streaming session (body: SessionMeta
+                            fields + optional out_path/alert_iters)
+- ``POST /sessions/<id>/blocks``  one subint block as an NPZ body
+                            (online/blocks.py) -> provisional zap alert
+- ``POST /sessions/<id>/finish``  canonical finalize -> final manifest
+- ``GET  /sessions/<id>``   session manifest
 - ``GET  /healthz``         liveness + backend mode + queue depths
 - ``GET  /metrics``         the process-global per-phase counters
                             (utils/tracing.py: ``*_s`` total seconds,
-                            ``*_n`` counts, ``service_*`` events)
+                            ``*_n`` counts, ``*_max_s`` worst single
+                            occurrence, ``service_*``/``online_*`` events)
 
 ThreadingHTTPServer: each request gets a thread, so a slow client cannot
 stall the poll loop; all handlers only touch thread-safe service surfaces
-(spool writes are serialized, counters are locked, submission enqueues).
+(spool writes are serialized, counters are locked, submission enqueues,
+session mutations hold per-session locks).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import sys
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from iterative_cleaner_tpu.utils import tracing
+
+#: Default per-socket-read timeout; ``ICT_HTTP_TIMEOUT_S`` overrides — a
+#: streaming client uploading multi-hundred-MB blocks over a slow link
+#: needs more than the one-shot default, and raising it globally for
+#: everyone would let dead sockets pin handler threads longer.
+DEFAULT_HTTP_TIMEOUT_S = 30.0
+
+
+def http_timeout_s() -> float:
+    env = os.environ.get("ICT_HTTP_TIMEOUT_S")
+    if env is None:
+        return DEFAULT_HTTP_TIMEOUT_S
+    try:
+        val = float(env)
+        if val <= 0:
+            raise ValueError
+        return val
+    except ValueError:
+        print(f"warning: ignoring unparseable ICT_HTTP_TIMEOUT_S={env!r} "
+              f"(want a positive seconds count); using "
+              f"{DEFAULT_HTTP_TIMEOUT_S:g}", file=sys.stderr)
+        return DEFAULT_HTTP_TIMEOUT_S
 
 
 class _Handler(BaseHTTPRequestHandler):
     # Bound every socket read (BaseRequestHandler.setup applies this via
     # connection.settimeout): a client that under-sends its declared body
     # or never sends a request line must time out, not leak this handler
-    # thread and its FD forever.
-    timeout = 30
+    # thread and its FD forever.  The value is resolved per server at bind
+    # time (make_http_server) so ICT_HTTP_TIMEOUT_S takes effect without
+    # mutating class state shared by other servers in the process.
+    timeout = DEFAULT_HTTP_TIMEOUT_S
+
+    def setup(self) -> None:
+        self.timeout = self.server.http_timeout_s
+        BaseHTTPRequestHandler.setup(self)
 
     # The default handler logs every request line to stderr; route through
     # the service's quiet flag instead (a health-checked daemon would spam).
@@ -45,6 +83,18 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _read_body(self, clamp: int) -> bytes:
+        # Clamp the client-supplied length: a negative value would make
+        # read() block until EOF (leaking this handler thread) and a
+        # huge one would buffer it all.  A MALFORMED header reads as an
+        # empty body — the downstream parse then 400s, it never drops the
+        # socket (online/blocks.py's contract).
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+        except (TypeError, ValueError):
+            n = 0
+        return self.rfile.read(max(0, min(n, clamp)))
+
     def do_GET(self) -> None:  # noqa: N802 — stdlib signature
         service = self.server.service
         if self.path == "/healthz":
@@ -57,21 +107,42 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(404, {"error": "no such job"})
             else:
                 self._reply(200, job.to_dict())
+        elif self.path.startswith("/sessions/"):
+            sid = self.path[len("/sessions/"):]
+            self._session_call(lambda s: s.manifest(sid))
         else:
             self._reply(404, {"error": f"no such route {self.path!r}"})
 
     def do_POST(self) -> None:  # noqa: N802 — stdlib signature
         service = self.server.service
-        if self.path != "/jobs":
-            self._reply(404, {"error": f"no such route {self.path!r}"})
+        if self.path == "/jobs":
+            self._post_job()
             return
+        if self.path == "/sessions":
+            self._post_session_open()
+            return
+        if self.path.startswith("/sessions/"):
+            rest = self.path[len("/sessions/"):]
+            sid, sep, verb = rest.partition("/")
+            if sep and verb == "blocks":
+                from iterative_cleaner_tpu.online.blocks import (
+                    MAX_BLOCK_BYTES,
+                )
+
+                payload = self._read_body(MAX_BLOCK_BYTES)
+                self._session_call(lambda s: s.add_block(sid, payload))
+                return
+            if sep and verb == "finish":
+                self._session_call(lambda s: s.finish(sid))
+                return
+        self._reply(404, {"error": f"no such route {self.path!r}"})
+
+    # --- jobs ---
+
+    def _post_job(self) -> None:
+        service = self.server.service
         try:
-            # Clamp the client-supplied length: a negative value would make
-            # read() block until EOF (leaking this handler thread) and a
-            # huge one would buffer it all; job bodies are tiny.
-            n = max(0, min(int(self.headers.get("Content-Length", 0)),
-                           1 << 20))
-            body = json.loads(self.rfile.read(n) or b"{}")
+            body = json.loads(self._read_body(1 << 20) or b"{}")
             path = body["path"]
         # TypeError covers valid-JSON non-dict bodies ('[]', '5', 'null'):
         # the client gets a 400, not a dropped socket.
@@ -95,6 +166,50 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._reply(202, job.to_dict())
 
+    # --- streaming sessions ---
+
+    def _post_session_open(self) -> None:
+        service = self.server.service
+        try:
+            body = json.loads(self._read_body(1 << 20) or b"{}")
+            if not isinstance(body, dict):
+                raise TypeError("body must be a JSON object")
+            out_path = body.pop("out_path", None)
+            alert_iters = body.pop("alert_iters", None)
+            if out_path:
+                # The write target obeys the same --root trust boundary as
+                # submitted read paths (docs/SERVING.md trust model).
+                out_path = service._check_root(str(out_path))
+        except (ValueError, TypeError) as exc:
+            self._reply(400, {"error": f"bad session request: {exc}"})
+            return
+        self._session_call(
+            lambda s: s.create(body, out_path=out_path,
+                               alert_iters=alert_iters), code=201)
+
+    def _session_call(self, fn, code: int = 200) -> None:
+        """Run one SessionManager operation with the shared error mapping
+        (unknown id → 404, closed → 409, bad payload → 400)."""
+        from iterative_cleaner_tpu.service.sessions import (
+            SessionClosed,
+            UnknownSession,
+        )
+
+        sessions = self.server.service.sessions
+        if sessions is None:
+            self._reply(404, {"error": "streaming sessions are disabled"})
+            return
+        try:
+            self._reply(code, fn(sessions))
+        except UnknownSession:
+            self._reply(404, {"error": "no such session"})
+        except SessionClosed as exc:
+            self._reply(409, {"error": str(exc)})
+        except ValueError as exc:
+            self._reply(400, {"error": str(exc)})
+        except Exception as exc:  # noqa: BLE001 — the client deserves a 500
+            self._reply(500, {"error": f"session operation failed: {exc}"})
+
 
 def make_http_server(service, host: str, port: int) -> ThreadingHTTPServer:
     """Bind (port 0 -> ephemeral, for tests); caller runs serve_forever on
@@ -102,4 +217,5 @@ def make_http_server(service, host: str, port: int) -> ThreadingHTTPServer:
     server = ThreadingHTTPServer((host, port), _Handler)
     server.daemon_threads = True
     server.service = service
+    server.http_timeout_s = http_timeout_s()
     return server

@@ -61,6 +61,8 @@ class ServeConfig:
     max_open_jobs: int = 64          # admission cap (0 = unbounded): bounds
                                      # decoded-cube host residency; size it
                                      # to host RAM / cube size
+    alert_iters: int = 2             # streaming sessions: bounded provisional
+                                     # clean-pass iterations per block
     root: str = ""                   # when set, submitted paths must resolve
                                      # under this directory (the non-loopback
                                      # trust boundary)
@@ -91,6 +93,7 @@ class CleaningService:
         self._server = None
         self.scheduler = None
         self.worker = None
+        self.sessions = None
 
     # --- lifecycle ---
 
@@ -148,6 +151,21 @@ class CleaningService:
             self.pool = WarmPool(self.clean_cfg, self.mesh, self.bucket_cap,
                                  quiet=self.serve_cfg.quiet)
             self.pool.warm_startup(self.serve_cfg.warm_shapes)
+        from iterative_cleaner_tpu.service.sessions import SessionManager
+
+        # Streaming sessions (docs/SERVING.md "Streaming sessions"): spool-
+        # backed under the job spool, so the single-daemon flock covers them
+        # and a restart finds the replay log in place.  The cfg_provider
+        # re-reads backend_mode on every session touch, so both the startup
+        # liveness demotion and a RUNTIME service-wide demotion
+        # (note_dispatch_failure) reach streaming passes too.
+        self.sessions = SessionManager(
+            os.path.join(self.serve_cfg.spool_dir, "sessions"),
+            self.clean_cfg.replace(backend=self.backend_mode),
+            alert_iters=self.serve_cfg.alert_iters,
+            quiet=self.serve_cfg.quiet,
+            cfg_provider=lambda: self.clean_cfg.replace(
+                backend=self.backend_mode))
         self.worker = DispatchWorker(self)
         # Spool trim + replay run BEFORE any thread starts: the trim's
         # .json.part sweep is only safe while no writer thread exists (the
@@ -309,6 +327,8 @@ class CleaningService:
             "bucket_cap": self.bucket_cap,
             "deadline_s": self.serve_cfg.deadline_s,
             "warm_shapes": (self.pool.warm_shapes_now() if self.pool else []),
+            "open_sessions": (self.sessions.open_count()
+                              if self.sessions else 0),
             "spool": self.spool.root,
         }
 
@@ -399,6 +419,11 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "hardening for non-loopback --host: without it any "
                         "reachable client can make the daemon read any file "
                         "and write a _cleaned output next to it)")
+    p.add_argument("--alert_iters", type=int, default=2, metavar="N",
+                   help="streaming sessions: bounded provisional clean-pass "
+                        "iterations per ingested block (default 2; the "
+                        "authoritative mask always comes from the canonical "
+                        "finalize, docs/SERVING.md)")
     p.add_argument("--warm", action="append", default=[],
                    metavar="NSUBxNCHANxNBIN",
                    help="shape class to precompile at startup (repeatable), "
@@ -441,6 +466,8 @@ def serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
     if args.bucket_cap < 0:
         raise ValueError(f"--bucket_cap must be >= 0 (0 = the mesh's dp "
                          f"extent), got {args.bucket_cap}")
+    if args.alert_iters < 1:
+        raise ValueError(f"--alert_iters must be >= 1, got {args.alert_iters}")
     return ServeConfig(
         spool_dir=args.spool,
         host=args.host,
@@ -450,6 +477,7 @@ def serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
         loaders=args.loaders,
         spool_keep=args.spool_keep,
         max_open_jobs=args.max_open_jobs,
+        alert_iters=args.alert_iters,
         root=args.root,
         warm_shapes=parse_warm_shapes(args.warm),
         quiet=args.quiet,

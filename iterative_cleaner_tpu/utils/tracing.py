@@ -45,10 +45,17 @@ def count(name: str, inc: float = 1.0) -> None:
 
 
 def observe_phase(name: str, seconds: float) -> None:
-    """Record one completed phase: total seconds + occurrence count."""
+    """Record one completed phase: total seconds + occurrence count + the
+    worst single occurrence (``<name>_max_s``) — the summary pair gives the
+    mean, but a latency contract (the online path's per-block alert bound)
+    is about the tail, and max is the cheapest tail statistic that needs no
+    histogram state."""
     with _counters_lock:
         _counters[f"{name}_s"] = _counters.get(f"{name}_s", 0.0) + seconds
         _counters[f"{name}_n"] = _counters.get(f"{name}_n", 0.0) + 1.0
+        key = f"{name}_max_s"
+        if seconds > _counters.get(key, 0.0):
+            _counters[key] = seconds
 
 
 @contextlib.contextmanager
@@ -67,6 +74,22 @@ def counters_snapshot() -> dict[str, float]:
     """Point-in-time copy of every counter, sorted by name (stable JSON)."""
     with _counters_lock:
         return dict(sorted(_counters.items()))
+
+
+def snapshot(prefix: str = "") -> dict[str, float]:
+    """:func:`counters_snapshot`, optionally filtered to one subsystem's
+    ``prefix`` — the before/after idiom tests use so counter state from one
+    case never bleeds into another's assertions (delta = snapshot() minus an
+    earlier snapshot(), no global reset needed mid-process)."""
+    snap = counters_snapshot()
+    if not prefix:
+        return snap
+    return {k: v for k, v in snap.items() if k.startswith(prefix)}
+
+
+def delta(before: dict[str, float], key: str) -> float:
+    """Counter movement since a :func:`snapshot`; missing keys read 0."""
+    return counters_snapshot().get(key, 0.0) - before.get(key, 0.0)
 
 
 def reset_counters() -> None:

@@ -3,7 +3,6 @@ import pytest
 
 from iterative_cleaner_tpu.config import CleanConfig
 from iterative_cleaner_tpu.core.cleaner import clean_cube, find_bad_parts
-from iterative_cleaner_tpu.io.synthetic import make_archive
 from iterative_cleaner_tpu.ops.preprocess import preprocess
 
 

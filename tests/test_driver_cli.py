@@ -9,7 +9,7 @@ import pytest
 
 from iterative_cleaner_tpu.cli import build_parser, config_from_args, main
 from iterative_cleaner_tpu.config import CleanConfig
-from iterative_cleaner_tpu.driver import output_name, residual_name, process_archive, run
+from iterative_cleaner_tpu.driver import output_name, residual_name, run
 from iterative_cleaner_tpu.io.npz import NpzIO
 from iterative_cleaner_tpu.io.synthetic import make_archive
 from iterative_cleaner_tpu.models.surgical import SurgicalCleaner

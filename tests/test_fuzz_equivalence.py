@@ -116,6 +116,40 @@ def test_chunked_matches_numpy_fuzzed(seed):
                                   err_msg=f"block={block}")
 
 
+def run_online_case(archive, kw, seed, backend="jax", x64=False):
+    """Feed an archive through an OnlineSession in seed-random block splits
+    and canonically finalize — the online mode's fuzz harness (shared with
+    tools/fuzz_sweep.py).  Returns the finalize CleanResult."""
+    from iterative_cleaner_tpu.online import OnlineSession, SessionMeta
+
+    rng = np.random.default_rng(seed + 77)
+    sess = OnlineSession(
+        SessionMeta.from_archive(archive),
+        CleanConfig(backend=backend, x64=x64, **kw),
+        alert_iters=int(rng.integers(1, 3)))
+    lo, nsub = 0, archive.nsub
+    while lo < nsub:
+        bs = int(rng.integers(1, nsub - lo + 1))
+        sess.ingest(archive.data[lo: lo + bs], archive.weights[lo: lo + bs])
+        lo += bs
+    return sess.finalize().result
+
+
+@pytest.mark.parametrize("seed", range(40, 43))
+def test_online_finalize_matches_numpy_fuzzed(seed):
+    """The streaming route joins the fuzz matrix: random block splits and
+    bounded provisional passes must end in a finalize mask bit-identical to
+    the oracle on the assembled cube (the provisional masks themselves are
+    advisory by contract — docs/PARITY.md)."""
+    archive, kw = draw_case(seed)
+    res_np = clean_cube(*preprocess(archive),
+                        CleanConfig(backend="numpy", **kw))
+    res_on = run_online_case(archive, kw, seed)
+    np.testing.assert_array_equal(res_np.weights, res_on.weights)
+    assert res_np.loops == res_on.loops
+    assert res_np.converged == res_on.converged
+
+
 @pytest.mark.parametrize("seed", range(12, 16))
 def test_sharded_matches_numpy_fuzzed(seed):
     import jax

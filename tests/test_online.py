@@ -1,0 +1,520 @@
+"""ict-online: the streaming-ingest subsystem, end to end.
+
+The acceptance contract (ISSUE 2): a session fed subint blocks in any
+size/order the API admits emits provisional zap alerts per block (latency
+in /metrics) and finalizes to a mask bit-identical to the numpy oracle run
+on the assembled cube — via the CLI --follow tail and the daemon session
+routes, including after a mid-stream daemon restart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+from dataclasses import replace
+
+import jax
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import LoopState, clean_cube
+from iterative_cleaner_tpu.io.npz import NpzIO
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.ops.preprocess import preprocess
+from iterative_cleaner_tpu.online.blocks import decode_block, encode_block
+from iterative_cleaner_tpu.online.session import OnlineSession
+from iterative_cleaner_tpu.online.state import CleanState, SessionMeta
+from iterative_cleaner_tpu.parallel.mesh import make_mesh
+from iterative_cleaner_tpu.service import CleaningService, ServeConfig
+from iterative_cleaner_tpu.utils import tracing
+
+
+def _oracle_weights(archive, max_iter=3):
+    return clean_cube(*preprocess(archive),
+                      CleanConfig(backend="numpy", max_iter=max_iter)).weights
+
+
+# --- core pieces ---
+
+
+def test_loop_state_matches_clean_cube():
+    """The extracted resumable loop IS clean_cube's loop: driving a backend
+    through LoopState reproduces the stepwise result record for record."""
+    from iterative_cleaner_tpu.backends.numpy_backend import NumpyCleaner
+
+    archive = make_archive(nsub=6, nchan=16, nbin=64, seed=31)
+    D, w0 = preprocess(archive)
+    cfg = CleanConfig(backend="numpy", max_iter=4)
+    want = clean_cube(D, w0, cfg)
+
+    state = LoopState.start(w0)
+    state.run(NumpyCleaner(D, w0, cfg), cfg.max_iter)
+    got = state.result(timed=True)
+    np.testing.assert_array_equal(got.weights, want.weights)
+    assert got.loops == want.loops and got.converged == want.converged
+    assert len(got.history) == len(want.history)
+    for a, b in zip(got.history, want.history):
+        np.testing.assert_array_equal(a, b)
+    assert [i.diff_weights for i in got.iterations] == [
+        i.diff_weights for i in want.iterations]
+
+
+def test_loop_state_resume_counts_total_iterations():
+    from iterative_cleaner_tpu.backends.numpy_backend import NumpyCleaner
+
+    archive = make_archive(nsub=6, nchan=16, nbin=64, seed=32)
+    D, w0 = preprocess(archive)
+    cfg = CleanConfig(backend="numpy", max_iter=5)
+    state = LoopState.start(w0)
+    backend = NumpyCleaner(D, w0, cfg)
+    state.run(backend, 1)           # bounded first pass
+    assert len(state.infos) == 1
+    state.run(backend, 5)           # resumed to the full budget
+    want = clean_cube(D, w0, cfg)
+    np.testing.assert_array_equal(state.history[-1], want.weights)
+    assert state.loops == want.loops and state.converged == want.converged
+
+
+def test_clean_state_amortized_doubling_and_views():
+    meta = SessionMeta(nchan=4, nbin=8, dm=0.0, dedispersed=True)
+    st = CleanState(meta)
+    caps = []
+    for k in range(9):
+        st.append_block(np.full((1, 1, 4, 8), float(k), np.float32),
+                        np.ones((1, 4), np.float32))
+        caps.append(st.capacity)
+    assert st.nsub == 9 and caps == [4, 4, 4, 4, 8, 8, 8, 8, 16]
+    assert st.raw.shape == (9, 1, 4, 8)
+    # rows survive the reallocation copies
+    assert float(st.raw[3, 0, 0, 0]) == 3.0
+    with pytest.raises(ValueError):
+        st.append_block(np.zeros((1, 1, 5, 8), np.float32),
+                        np.ones((1, 5), np.float32))
+    with pytest.raises(ValueError):
+        st.append_block(np.zeros((2, 1, 4, 8), np.float32),
+                        np.ones((1, 4), np.float32))
+
+
+def test_block_codec_roundtrip_and_rejection():
+    data = np.arange(2 * 1 * 3 * 4, dtype=np.float32).reshape(2, 1, 3, 4)
+    w = np.ones((2, 3), np.float32)
+    d2, w2 = decode_block(encode_block(data, w))
+    np.testing.assert_array_equal(d2, data)
+    np.testing.assert_array_equal(w2, w)
+    for junk in (b"", b"not a zip", b"PK\x03\x04broken"):
+        with pytest.raises(ValueError):
+            decode_block(junk)
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_session_blocks_alerts_and_oracle_identical_finalize(backend):
+    """Blocks in → per-block provisional alerts → finalize bit-identical to
+    the oracle on the assembled cube, on both pass backends."""
+    archive = make_archive(nsub=8, nchan=16, nbin=64, seed=40)
+    cfg = CleanConfig(backend=backend, max_iter=3)
+    before = tracing.snapshot("online")
+    sess = OnlineSession(SessionMeta.from_archive(archive), cfg,
+                         alert_iters=2)
+    lo = 0
+    for bs in (3, 1, 4):     # deliberately uneven block sizes
+        alert = sess.ingest(archive.data[lo:lo + bs],
+                            archive.weights[lo:lo + bs])
+        assert (alert.subint_lo, alert.subint_hi) == (lo, lo + bs)
+        assert alert.latency_s > 0
+        assert alert.n_new_zaps >= len(alert.new_zaps)
+        lo += bs
+    assert sess.blocks_ingested == 3
+
+    fin = sess.finalize()
+    np.testing.assert_array_equal(fin.result.weights,
+                                  _oracle_weights(archive))
+    assert fin.provisional_mismatches >= 0
+    # latency counters moved, max exposed alongside the _s/_n pair
+    assert tracing.delta(before, "online_block_n") == 3
+    assert tracing.delta(before, "online_pass_n") == 3
+    assert tracing.counters_snapshot()["online_block_max_s"] > 0
+    with pytest.raises(ValueError):
+        sess.ingest(archive.data[:1], archive.weights[:1])  # closed
+
+
+def test_session_meta_validation():
+    with pytest.raises(ValueError):
+        SessionMeta.from_dict({"nchan": 4})          # nbin missing
+    with pytest.raises(ValueError):
+        SessionMeta.from_dict({"nchan": 4, "nbin": 8, "bogus": 1})
+    m = SessionMeta.from_dict({"nchan": 4, "nbin": 8, "dedispersed": True})
+    assert len(m.freqs) == 4                          # centre-filled
+    with pytest.raises(ValueError):
+        OnlineSession(m, CleanConfig(), alert_iters=0)
+    # dm != 0 on a dispersed session with unusable frequencies (the
+    # centre-fill default would rotate by garbage) is refused at open
+    with pytest.raises(ValueError, match="positive"):
+        SessionMeta.from_dict({"nchan": 4, "nbin": 8, "dm": 50.0})
+    # dedispersed streams never compute shifts, so they stay accepted
+    SessionMeta.from_dict({"nchan": 4, "nbin": 8, "dm": 50.0,
+                           "dedispersed": True})
+
+
+def test_ingest_failure_rolls_the_append_back(monkeypatch):
+    """A provisional pass that dies mid-block must not leave the slab and
+    the provisional mask out of step — the block is simply resubmittable."""
+    archive = make_archive(nsub=6, nchan=16, nbin=64, seed=45)
+    sess = OnlineSession(SessionMeta.from_archive(archive),
+                         CleanConfig(backend="numpy", max_iter=3))
+    sess.ingest(archive.data[:2], archive.weights[:2])
+    prov_before = sess.state.prov_w.copy()
+
+    def boom(lo, hi):
+        raise RuntimeError("synthetic backend death")
+
+    monkeypatch.setattr(sess, "_provisional_pass", boom)
+    with pytest.raises(RuntimeError):
+        sess.ingest(archive.data[2:4], archive.weights[2:4])
+    assert sess.state.nsub == 2 and sess.blocks_ingested == 1
+    np.testing.assert_array_equal(sess.state.prov_w, prov_before)
+    monkeypatch.undo()
+    # the resubmitted block and the rest of the stream work normally
+    sess.ingest(archive.data[2:4], archive.weights[2:4])
+    sess.ingest(archive.data[4:], archive.weights[4:])
+    np.testing.assert_array_equal(sess.finalize().result.weights,
+                                  _oracle_weights(archive))
+
+
+def test_replay_block_skips_provisional_passes():
+    archive = make_archive(nsub=6, nchan=16, nbin=64, seed=46)
+    before = tracing.snapshot("online")
+    sess = OnlineSession(SessionMeta.from_archive(archive),
+                         CleanConfig(backend="numpy", max_iter=3))
+    sess.replay_block(archive.data[:3], archive.weights[:3])
+    assert sess.blocks_ingested == 1 and sess.state.nsub == 3
+    assert tracing.delta(before, "online_pass_n") == 0
+    # the first live ingest after a replay covers the whole cube
+    alert = sess.ingest(archive.data[3:], archive.weights[3:])
+    assert alert.nsub_total == 6
+    assert tracing.delta(before, "online_pass_n") == 1
+    np.testing.assert_array_equal(sess.finalize().result.weights,
+                                  _oracle_weights(archive))
+
+
+def test_session_manager_follows_backend_demotion(tmp_path):
+    """A runtime service-wide backend demotion must reach streaming
+    sessions (the cfg_provider re-resolution), not just job dispatch."""
+    from iterative_cleaner_tpu.service.sessions import SessionManager
+
+    archive = make_archive(nsub=4, nchan=16, nbin=64, seed=47)
+    mode = {"backend": "jax"}
+    mgr = SessionManager(
+        str(tmp_path / "sessions"), CleanConfig(backend="jax", max_iter=3),
+        cfg_provider=lambda: CleanConfig(backend=mode["backend"], max_iter=3))
+    sid = mgr.create(SessionMeta.from_archive(archive).to_dict())["id"]
+    mgr.add_block(sid, encode_block(archive.data[:2], archive.weights[:2]))
+    mode["backend"] = "numpy"   # the demotion
+    mgr.add_block(sid, encode_block(archive.data[2:], archive.weights[2:]))
+    with mgr._lock:
+        assert mgr._live[sid].cfg.backend == "numpy"
+    fin = mgr.finish(sid)
+    np.testing.assert_array_equal(
+        NpzIO().load(fin["out_path"]).weights, _oracle_weights(archive))
+
+
+# --- CLI --follow ---
+
+
+def _write_prefix(full, path, n):
+    part = replace(full, data=full.data[:n].copy(),
+                   weights=full.weights[:n].copy())
+    NpzIO().save(part, f"{path}.tmp")
+    os.replace(f"{path}.tmp", path)
+
+
+def test_follow_tails_growth_and_finalizes_oracle_identical(
+        tmp_path, monkeypatch, capsys):
+    """The file-tail route: growth steps land as provisional alerts; the
+    .eos sentinel triggers the canonical clean of the completed file."""
+    from iterative_cleaner_tpu.driver import run_follow
+
+    monkeypatch.chdir(tmp_path)
+    full = make_archive(nsub=8, nchan=16, nbin=64, seed=41)
+    path = str(tmp_path / "grow.npz")
+    _write_prefix(full, path, 3)
+    steps = iter([lambda: _write_prefix(full, path, 8),
+                  lambda: open(f"{path}.eos", "w").close()])
+    cfg = CleanConfig(backend="jax", max_iter=3, no_log=True)
+    reports = run_follow([path], cfg, poll_s=0.01, idle_timeout_s=60,
+                         sleep=lambda s: next(steps, lambda: None)())
+    assert reports[0].error is None
+    np.testing.assert_array_equal(
+        NpzIO().load(reports[0].out_path).weights, _oracle_weights(full))
+    err = capsys.readouterr().err
+    assert "provisional zap" in err and "end of stream" in err
+
+
+def test_follow_cli_flag_and_missing_file(tmp_path, monkeypatch, capsys):
+    from iterative_cleaner_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("ICT_NO_COMPILE_CACHE", "1")
+    # a stream that never materializes fails per-archive with rc 1
+    rc = main(["--follow", "--follow_poll", "0.01", "--follow_timeout",
+               "0.05", "-q", "-l", str(tmp_path / "never.npz")])
+    assert rc == 1
+    assert "ERROR following" in capsys.readouterr().err
+    # invalid combinations are usage errors
+    assert main(["--follow", "--sharded_batch", "x.npz"]) == 2
+    assert main(["--follow", "--alert_iters", "0", "x.npz"]) == 2
+
+
+def test_follow_complete_file_with_eos_sentinel(tmp_path, monkeypatch):
+    """A file already complete when --follow starts (sentinel present) is
+    one ingest + finalize — the degenerate stream."""
+    from iterative_cleaner_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("ICT_NO_COMPILE_CACHE", "1")
+    full = make_archive(nsub=4, nchan=16, nbin=64, seed=42)
+    path = str(tmp_path / "done.npz")
+    NpzIO().save(full, path)
+    open(f"{path}.eos", "w").close()
+    rc = main(["--follow", "--follow_poll", "0.01", "-q", "-l", "-m", "3",
+               path])
+    assert rc == 0
+    np.testing.assert_array_equal(
+        NpzIO().load(f"{path}_cleaned.npz").weights, _oracle_weights(full))
+
+
+# --- daemon session routes ---
+
+
+def _start(tmp_path, **kw):
+    mesh = make_mesh(8, devices=jax.devices("cpu"))
+    defaults = dict(spool_dir=str(tmp_path / "spool"), port=0,
+                    deadline_s=0.2, quiet=True,
+                    clean=CleanConfig(backend="jax", max_iter=3, quiet=True,
+                                      no_log=True))
+    defaults.update(kw)
+    svc = CleaningService(ServeConfig(**defaults), mesh=mesh)
+    svc.start()
+    return svc
+
+
+def _post(svc, route, data, expect_error=False,
+          ctype="application/octet-stream"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{svc.port}{route}", data=data,
+        headers={"Content-Type": ctype})
+    try:
+        return json.load(urllib.request.urlopen(req, timeout=30))
+    except urllib.error.HTTPError as exc:
+        if expect_error:
+            return exc.code
+        raise
+
+
+def _get(svc, route, expect_error=False):
+    try:
+        return json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}{route}", timeout=30))
+    except urllib.error.HTTPError as exc:
+        if expect_error:
+            return exc.code
+        raise
+
+
+def test_daemon_session_end_to_end(tmp_path):
+    """POST /sessions → blocks → finish over real HTTP: alerts per block,
+    oracle-identical final mask, /metrics latency, error mapping."""
+    archive = make_archive(nsub=6, nchan=16, nbin=64, seed=43)
+    before = tracing.snapshot()
+    svc = _start(tmp_path)
+    try:
+        meta = SessionMeta.from_archive(archive).to_dict()
+        sess = _post(svc, "/sessions", json.dumps(meta).encode(),
+                     ctype="application/json")
+        assert sess["state"] == "open" and sess["blocks"] == 0
+        sid = sess["id"]
+
+        a1 = _post(svc, f"/sessions/{sid}/blocks",
+                   encode_block(archive.data[:4], archive.weights[:4]))
+        assert a1["block_index"] == 0 and a1["nsub_total"] == 4
+        assert a1["latency_s"] > 0
+        a2 = _post(svc, f"/sessions/{sid}/blocks",
+                   encode_block(archive.data[4:], archive.weights[4:]))
+        assert a2["subint_lo"] == 4 and a2["nsub_total"] == 6
+
+        man = _get(svc, f"/sessions/{sid}")
+        assert man["state"] == "open" and man["blocks"] == 2
+        assert man["nsub"] == 6
+
+        fin = _post(svc, f"/sessions/{sid}/finish", b"")
+        assert fin["state"] == "done" and fin["blocks"] == 2
+        got = NpzIO().load(fin["out_path"])
+        np.testing.assert_array_equal(got.weights, _oracle_weights(archive))
+
+        # terminal session: manifest persists, further mutation is 409
+        assert _get(svc, f"/sessions/{sid}")["state"] == "done"
+        assert _post(svc, f"/sessions/{sid}/blocks",
+                     encode_block(archive.data[:1], archive.weights[:1]),
+                     expect_error=True) == 409
+        assert _post(svc, f"/sessions/{sid}/finish", b"",
+                     expect_error=True) == 409
+
+        # error mapping: unknown/traversal ids 404, garbage payloads 400
+        assert _get(svc, "/sessions/nope", expect_error=True) == 404
+        assert _get(svc, "/sessions/../escape", expect_error=True) == 404
+        assert _post(svc, "/sessions", b"[]", expect_error=True,
+                     ctype="application/json") == 400
+        assert _post(svc, "/sessions", b'{"nchan": 4}', expect_error=True,
+                     ctype="application/json") == 400
+        sess2 = _post(svc, "/sessions", json.dumps(meta).encode(),
+                      ctype="application/json")
+        assert _post(svc, f"/sessions/{sess2['id']}/blocks", b"junk",
+                     expect_error=True) == 400
+        wrong = encode_block(np.zeros((1, 1, 5, 64), np.float32),
+                             np.ones((1, 5), np.float32))
+        assert _post(svc, f"/sessions/{sess2['id']}/blocks", wrong,
+                     expect_error=True) == 400
+        assert _post(svc, f"/sessions/{sess2['id']}/finish", b"",
+                     expect_error=True) == 400   # no blocks to finalize
+
+        metrics = _get(svc, "/metrics")
+        # online_block_n also counts the REFUSED ingests above (the
+        # tracing.phase exceptions-count rule); the success counter is
+        # exact and the latency summary/max are what /metrics promises.
+        assert metrics["online_blocks_ingested"] - before.get(
+            "online_blocks_ingested", 0) == 2
+        assert metrics["online_block_n"] - before.get(
+            "online_block_n", 0) >= 2
+        assert metrics["online_block_max_s"] > 0
+        assert metrics["online_sessions_finished"] - before.get(
+            "online_sessions_finished", 0) == 1
+        assert _get(svc, "/healthz")["open_sessions"] == 1   # sess2 open
+    finally:
+        svc.stop()
+
+
+def test_daemon_session_resumes_after_restart(tmp_path):
+    """Mid-stream daemon death: the next daemon replays the spooled blocks,
+    accepts the rest of the stream, and finalizes oracle-identical."""
+    archive = make_archive(nsub=6, nchan=16, nbin=64, seed=44)
+    meta = SessionMeta.from_archive(archive).to_dict()
+    svc = _start(tmp_path)
+    try:
+        sid = _post(svc, "/sessions", json.dumps(meta).encode(),
+                    ctype="application/json")["id"]
+        _post(svc, f"/sessions/{sid}/blocks",
+              encode_block(archive.data[:2], archive.weights[:2]))
+    finally:
+        svc.stop()
+
+    before = tracing.snapshot()
+    svc2 = _start(tmp_path)
+    try:
+        assert _get(svc2, "/healthz")["open_sessions"] == 1
+        a = _post(svc2, f"/sessions/{sid}/blocks",
+                  encode_block(archive.data[2:], archive.weights[2:]))
+        assert a["block_index"] == 1 and a["nsub_total"] == 6
+        assert tracing.delta(before, "online_blocks_replayed") == 1
+        # replay appends only — the sole provisional pass since restart is
+        # the live block's (restart cost O(slab), not O(blocks x pass))
+        assert tracing.delta(before, "online_pass_n") == 1
+        fin = _post(svc2, f"/sessions/{sid}/finish", b"")
+        assert fin["state"] == "done"
+        np.testing.assert_array_equal(
+            NpzIO().load(fin["out_path"]).weights, _oracle_weights(archive))
+    finally:
+        svc2.stop()
+
+
+def test_session_out_path_respects_root(tmp_path):
+    """A client-named session output obeys the --root trust boundary."""
+    data = tmp_path / "data"
+    data.mkdir()
+    svc = _start(tmp_path, root=str(data))
+    try:
+        meta = dict(nchan=4, nbin=8, dedispersed=True,
+                    out_path="/etc/evil.npz")
+        assert _post(svc, "/sessions", json.dumps(meta).encode(),
+                     expect_error=True, ctype="application/json") == 400
+        meta["out_path"] = str(data / "ok.npz")
+        sess = _post(svc, "/sessions", json.dumps(meta).encode(),
+                     ctype="application/json")
+        assert sess["state"] == "open"
+    finally:
+        svc.stop()
+
+
+def test_rejected_session_open_leaves_no_residue(tmp_path):
+    """A refused POST /sessions (bad alert_iters, bad meta) must not leak a
+    meta-less session directory into the open-session count."""
+    svc = _start(tmp_path, clean=CleanConfig(backend="numpy", quiet=True))
+    try:
+        for body in (dict(nchan=4, nbin=8, dedispersed=True, alert_iters=-1),
+                     dict(nchan=4, nbin=8, dedispersed=True, alert_iters=0),
+                     dict(nchan=4)):
+            assert _post(svc, "/sessions", json.dumps(body).encode(),
+                         expect_error=True, ctype="application/json") == 400
+        assert _get(svc, "/healthz")["open_sessions"] == 0
+        assert os.listdir(str(tmp_path / "spool" / "sessions")) == []
+    finally:
+        svc.stop()
+
+
+def test_malformed_content_length_gets_400_not_dropped_socket(tmp_path):
+    import http.client
+
+    svc = _start(tmp_path, clean=CleanConfig(backend="numpy", quiet=True))
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", svc.port, timeout=30)
+        conn.putrequest("POST", "/sessions")
+        conn.putheader("Content-Length", "not-a-number")
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 400   # empty body -> meta validation 400
+        conn.close()
+    finally:
+        svc.stop()
+
+
+# --- satellites ---
+
+
+def test_http_timeout_env_override(monkeypatch, capsys):
+    from iterative_cleaner_tpu.service.api import (
+        DEFAULT_HTTP_TIMEOUT_S,
+        http_timeout_s,
+    )
+
+    assert http_timeout_s() == DEFAULT_HTTP_TIMEOUT_S
+    monkeypatch.setenv("ICT_HTTP_TIMEOUT_S", "120")
+    assert http_timeout_s() == 120.0
+    monkeypatch.setenv("ICT_HTTP_TIMEOUT_S", "bogus")
+    assert http_timeout_s() == DEFAULT_HTTP_TIMEOUT_S
+    assert "ICT_HTTP_TIMEOUT_S" in capsys.readouterr().err
+    monkeypatch.setenv("ICT_HTTP_TIMEOUT_S", "-1")
+    assert http_timeout_s() == DEFAULT_HTTP_TIMEOUT_S
+
+
+def test_http_server_applies_timeout(tmp_path, monkeypatch):
+    monkeypatch.setenv("ICT_HTTP_TIMEOUT_S", "77")
+    svc = _start(tmp_path, clean=CleanConfig(backend="numpy", quiet=True))
+    try:
+        assert svc._server.http_timeout_s == 77.0
+    finally:
+        svc.stop()
+
+
+def test_tracing_snapshot_delta_and_max():
+    tracing.observe_phase("t_online_unit", 0.5)
+    tracing.observe_phase("t_online_unit", 0.25)
+    snap = tracing.snapshot("t_online_unit")
+    assert snap["t_online_unit_n"] == 2.0
+    assert snap["t_online_unit_s"] == pytest.approx(0.75)
+    assert snap["t_online_unit_max_s"] == pytest.approx(0.5)
+    before = tracing.snapshot()
+    tracing.count("t_online_unit_evt")
+    assert tracing.delta(before, "t_online_unit_evt") == 1.0
+    # prefix filter excludes foreign counters
+    assert all(k.startswith("t_online_unit") for k in snap)

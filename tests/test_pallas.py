@@ -21,7 +21,6 @@ from iterative_cleaner_tpu.core.cleaner import clean_cube
 from iterative_cleaner_tpu.io.synthetic import make_archive, RFISpec
 from iterative_cleaner_tpu.ops.pallas_kernels import fused_fit_moments, use_interpret
 from iterative_cleaner_tpu.ops.preprocess import preprocess
-from iterative_cleaner_tpu.ops.stats import diagnostics
 from iterative_cleaner_tpu.ops.template import build_template, fit_and_subtract
 
 

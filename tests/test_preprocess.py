@@ -1,6 +1,6 @@
 import numpy as np
 
-from iterative_cleaner_tpu.io.synthetic import make_archive, pulse_profile, RFISpec
+from iterative_cleaner_tpu.io.synthetic import make_archive, pulse_profile
 from iterative_cleaner_tpu.ops.preprocess import (
     baseline_window,
     dispersion_shifts,

@@ -2,9 +2,10 @@
 
 Draws random configurations with tests/test_fuzz_equivalence.py's generator
 and demands bit-identical final masks between the numpy oracle and every JAX
-execution mode — stepwise, fused, chunked (random block), and the 8-device
-sharded path — plus loop-count agreement.  Any failing seed is reproducible
-directly in the CI test by adding it to the parametrize range.
+execution mode — stepwise, fused, chunked (random block), the 8-device
+sharded path, and the streaming-ingest online route (random block splits,
+canonical finalize) — plus loop-count agreement.  Any failing seed is
+reproducible directly in the CI test by adding it to the parametrize range.
 
 Usage: python tools/fuzz_sweep.py [n_seeds] [start]
 
@@ -41,7 +42,7 @@ def main() -> int:
 
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-    from test_fuzz_equivalence import draw_case  # noqa: E402
+    from test_fuzz_equivalence import draw_case, run_online_case  # noqa: E402
 
     from iterative_cleaner_tpu.config import CleanConfig
     from iterative_cleaner_tpu.core.cleaner import clean_cube
@@ -92,6 +93,13 @@ def main() -> int:
         ):
             r = clean_cube(D, w0, cfg)
             modes[name] = (r.weights, r.loops, r.converged)
+
+        # The streaming-ingest route: seed-random block splits, bounded
+        # provisional passes, then the canonical finalize — whose mask must
+        # match the oracle on the assembled cube (the provisional masks are
+        # advisory by contract and not compared).
+        r_on = run_online_case(archive, kw, seed, x64=x64)
+        modes["online"] = (r_on.weights, r_on.loops, r_on.converged)
 
         if not x64:  # the sharded path deliberately declines x64
             _t, w_sh, loops_sh, done_sh = sharded_clean_single(
