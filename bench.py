@@ -119,7 +119,28 @@ def _emit(payload: dict) -> None:
 def _headline(payload: dict) -> dict:
     """Order the one-line JSON: driver keys first, then the detail.  The
     metric name reflects the shape that actually ran (the CPU fallback
-    shrinks it)."""
+    shrinks it).  Called on EVERY exit path (success, exception, watchdog),
+    so the compile/cache accounting deltas land even in a degraded
+    payload."""
+    try:
+        from iterative_cleaner_tpu.obs import tracing as _obs_tracing
+
+        snap = _obs_tracing.snapshot()
+        payload.setdefault("compile_accounting", {
+            # Real backend compiles seen by the jax monitoring listener
+            # (count + total seconds), plus the in-process executable-cache
+            # accounting (a key hit = an executable set already live).
+            "backend_compiles_n": int(snap.get("jax_compile_n", 0)),
+            "backend_compile_s": round(snap.get("jax_compile_s", 0.0), 3),
+            "compile_cache_key_hits": int(
+                snap.get("compile_cache_key_hits", 0)),
+            "compile_cache_key_misses": int(
+                snap.get("compile_cache_key_misses", 0)),
+            "persistent_cache_hits": int(
+                snap.get("persistent_cache_hits", 0)),
+        })
+    except Exception:  # noqa: BLE001 — the JSON line is the contract
+        pass
     value = payload.get("end_to_end_speedup", 0.0)
     shape = payload.get("config_a", {}).get("shape", [NSUB, NCHAN, NBIN])
     out = {
@@ -819,6 +840,12 @@ def _bench_chunked(state, upload_gbps: float) -> dict:
 def run_bench() -> dict:
     dev = _init_device()
     _PAYLOAD["device"] = f"{dev.platform}:{dev.device_kind}"
+    # After the killable device probe (a jax import is safe; only backend
+    # INIT can hang on a wedged tunnel): account every backend compile the
+    # run pays, for the compile_accounting block of the payload.
+    from iterative_cleaner_tpu.obs.tracing import install_compile_listener
+
+    install_compile_listener()
     import jax
 
     from iterative_cleaner_tpu.ops.template import _LOWERING

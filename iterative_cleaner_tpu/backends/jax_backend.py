@@ -251,8 +251,11 @@ def start_precompile(shape, cfg, want_residual: bool = False):
                 return
             # Account the warm's executables BEFORE compiling them: a due
             # compile-cache drop then lands here, not between the warm and
-            # the real call (which notes the identical key — a set, so no
-            # double count).
+            # the real call.  The real call re-notes the identical key — no
+            # double count toward the drop budget (a set), and the re-note
+            # lands in telemetry as a compile_cache_key_hit BY DESIGN: the
+            # real dispatch reuses (or joins) this warm's executables, which
+            # is exactly what the hit counter measures.
             note_compiled_shape(key)
             precompile_for(shape, cfg, want_residual)
         except Exception:  # noqa: BLE001 — warmup only; real call recovers

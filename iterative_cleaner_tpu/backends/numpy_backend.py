@@ -82,13 +82,13 @@ def robust_scale(arr2d, axis: int):
     return out
 
 
-def comprehensive_stats(data_ma: np.ma.MaskedArray, cfg: CleanConfig) -> np.ndarray:
-    """Four robust diagnostics → per-profile outlier score (reference
-    iterative_cleaner.py:180-225).
-
-    The returned array is plain (masks are dropped at the max step, §8.L2);
-    fully-masked profiles come out NaN and are never flagged (§8.L3).
-    """
+def scaled_diagnostics(data_ma: np.ma.MaskedArray, cfg: CleanConfig) -> list:
+    """The four per-diagnostic combined scores, in (std, mean, ptp, fft)
+    order — each the threshold-scaled, mask-dropping max of the per-channel
+    / per-subint robust scalings (reference iterative_cleaner.py:180-225).
+    :func:`comprehensive_stats` medians these into the outlier score; the
+    forensics attribution (obs/forensics.py) votes on them individually —
+    ONE implementation of the §8-landmine-heavy pipeline for both."""
     centred = data_ma - np.expand_dims(data_ma.mean(axis=2), axis=2)
     diagnostics = [
         np.ma.std(data_ma, axis=2),
@@ -103,7 +103,17 @@ def comprehensive_stats(data_ma: np.ma.MaskedArray, cfg: CleanConfig) -> np.ndar
         per_subint = np.abs(robust_scale(diag, axis=1)) / cfg.subintthresh
         # np.max over the pair coerces to raw data — the mask-drop (§8.L2).
         scaled.append(np.max((per_chan, per_subint), axis=0))
-    return np.median(scaled, axis=0)
+    return scaled
+
+
+def comprehensive_stats(data_ma: np.ma.MaskedArray, cfg: CleanConfig) -> np.ndarray:
+    """Four robust diagnostics → per-profile outlier score (reference
+    iterative_cleaner.py:180-225).
+
+    The returned array is plain (masks are dropped at the max step, §8.L2);
+    fully-masked profiles come out NaN and are never flagged (§8.L3).
+    """
+    return np.median(scaled_diagnostics(data_ma, cfg), axis=0)
 
 
 class NumpyCleaner:

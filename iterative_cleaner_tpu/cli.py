@@ -132,6 +132,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "stepwise mode) as <output>_masks.npz")
     p.add_argument("--trace", type=str, default="", metavar="DIR",
                    help="write a jax.profiler trace to DIR")
+    p.add_argument("--telemetry", type=str, default="", metavar="PATH",
+                   help="append structured telemetry events (trace context, "
+                        "route decisions, per-iteration convergence "
+                        "forensics) to PATH as JSON lines; the run's "
+                        "trace_id ties every event to this invocation "
+                        "(ICT_TELEMETRY env equivalent; "
+                        "docs/OBSERVABILITY.md)")
     p.add_argument("--report", type=str, default="", metavar="PATH",
                    help="write a machine-readable JSON run report (one object "
                         "per archive: output, loops, rfi_frac, converged, "
@@ -215,6 +222,10 @@ def main(argv: list[str] | None = None) -> int:
     except ValueError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
+    from iterative_cleaner_tpu.obs import events
+
+    if args.telemetry:
+        events.configure(args.telemetry)
     if cfg.backend == "jax":
         # A wedged remote-TPU tunnel hangs the first in-process jax call
         # forever; probe killably and demote to CPU loudly instead
@@ -232,21 +243,30 @@ def main(argv: list[str] | None = None) -> int:
         # (ICT_NO_COMPILE_CACHE=1 opts out).  The trim keeps the on-disk
         # cache size-bounded (ICT_COMPILE_CACHE_MAX_MB; ADVICE r05).
         enable_and_trim_persistent_cache()
-    if sweep_pairs is not None:
-        from iterative_cleaner_tpu.driver import run_sweep
+        if events.enabled():
+            from iterative_cleaner_tpu.obs import tracing
 
-        reports = run_sweep(args.archive, cfg, sweep_pairs)
-    elif args.follow:
-        from iterative_cleaner_tpu.driver import run_follow
+            tracing.install_compile_listener()
+    # The CLI is an entry point: mint the run's trace context and bind it
+    # so every nested telemetry event (route decisions, per-iteration
+    # forensics, per-archive spans) carries this invocation's trace_id.
+    with events.trace_scope(events.new_trace_id()), \
+            events.span("cli_run", argv=list(argv)):
+        if sweep_pairs is not None:
+            from iterative_cleaner_tpu.driver import run_sweep
 
-        reports = run_follow(
-            args.archive, cfg, poll_s=args.follow_poll,
-            idle_timeout_s=args.follow_timeout,
-            alert_iters=args.alert_iters)
-    else:
-        from iterative_cleaner_tpu.driver import run
+            reports = run_sweep(args.archive, cfg, sweep_pairs)
+        elif args.follow:
+            from iterative_cleaner_tpu.driver import run_follow
 
-        reports = run(args.archive, cfg)
+            reports = run_follow(
+                args.archive, cfg, poll_s=args.follow_poll,
+                idle_timeout_s=args.follow_timeout,
+                alert_iters=args.alert_iters)
+        else:
+            from iterative_cleaner_tpu.driver import run
+
+            reports = run(args.archive, cfg)
     if args.report:
         from iterative_cleaner_tpu.driver import write_report
 

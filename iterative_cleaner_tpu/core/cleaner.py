@@ -31,9 +31,16 @@ from iterative_cleaner_tpu.utils.compile_cache import (
 @dataclass
 class IterationInfo:
     index: int                 # 1-based loop counter (reference's `x`)
-    diff_weights: int          # entries changed vs previous weights
+    diff_weights: int          # mask churn: entries changed vs previous
+                               # weights (XOR popcount of the binarised masks)
     rfi_frac: float            # zapped fraction after this iteration
     duration_s: float = 0.0    # host wall-clock of this iteration's step
+    n_new_zaps: int = 0        # profiles newly zapped this iteration
+    n_unzapped: int = 0        # profiles restored this iteration
+    # Per-diagnostic vote counts among this iteration's zaps (std/mean/ptp/
+    # fft) — filled only under ICT_FORENSICS=1 (obs/forensics.py: a host
+    # replay of the oracle score pipeline; expensive, so asked-for).
+    zaps_by_diagnostic: dict | None = None
 
 
 @dataclass
@@ -48,6 +55,9 @@ class CleanResult:
     timed: bool = False                  # iterations carry real host wall-clock
                                          # laps (stepwise loops; the fused
                                          # single dispatch has none)
+    termination: str = ""                # "fixed_point" | "cycle" | "max_iter"
+                                         # ("" on routes that track no history,
+                                         # e.g. the sharded auto-reroute)
 
     @property
     def rfi_frac(self) -> float:
@@ -83,6 +93,7 @@ class LoopState:
     test_results: np.ndarray | None = None
     loops: int = 0
     converged: bool = False
+    termination: str = ""      # forensics: "fixed_point" | "cycle" | "max_iter"
 
     @classmethod
     def start(cls, w_init: np.ndarray) -> "LoopState":
@@ -93,6 +104,8 @@ class LoopState:
                 timer=None) -> bool:
         """Run one iteration; returns True when the loop should stop
         (the new mask reproduced any mask in the history)."""
+        from iterative_cleaner_tpu.obs import events, forensics
+
         x = len(self.infos) + 1
         test_results, new_w = backend.step(self.w_prev)
         self.test_results = np.asarray(test_results)
@@ -100,24 +113,37 @@ class LoopState:
 
         info = _iteration_info(x, self.history[-1], new_w,
                                duration_s=timer.lap() if timer else 0.0)
+        if forensics.attribution_enabled():
+            # Read-only host replay of the oracle score pipeline — which
+            # diagnostic voted for each of this iteration's zaps.  Uses the
+            # TEMPLATE weights (self.w_prev), the inputs the step ran with.
+            info.zaps_by_diagnostic = forensics.attribute_from_backend(
+                backend, self.w_prev, new_w)
         self.infos.append(info)
         if progress is not None:
             progress(info)
+        if events.enabled():
+            events.emit("iteration", **forensics.iteration_record(info))
 
-        # Full-history cycle detection, pre-loop weights included (§8.L10).
-        stop = any(np.array_equal(new_w, old) for old in self.history)
+        # Full-history cycle detection, pre-loop weights included (§8.L10);
+        # a match against the immediately previous mask is a fixed point,
+        # anything older a genuine oscillation.
+        fixed = np.array_equal(new_w, self.history[-1])
+        stop = fixed or any(
+            np.array_equal(new_w, old) for old in self.history[:-1])
         self.history.append(new_w)
         self.w_prev = new_w
         if stop:
             self.loops = x
             self.converged = True
+            self.termination = "fixed_point" if fixed else "cycle"
         return stop
 
     def run(self, backend, max_iter: int,
             progress: ProgressFn | None = None, timed: bool = True) -> None:
         """Advance until convergence or ``max_iter`` TOTAL iterations (a
         resumed state counts the iterations it already ran)."""
-        from iterative_cleaner_tpu.utils.tracing import StepTimer
+        from iterative_cleaner_tpu.obs.tracing import StepTimer
 
         timer = StepTimer() if timed else None
         while len(self.infos) < max_iter:
@@ -125,6 +151,7 @@ class LoopState:
                 break
         if not self.converged:
             self.loops = max_iter
+            self.termination = "max_iter"
 
     def result(self, residual: np.ndarray | None = None,
                timed: bool = False) -> CleanResult:
@@ -137,6 +164,7 @@ class LoopState:
             history=self.history,
             residual=residual,
             timed=timed,
+            termination=self.termination,
         )
 
 
@@ -146,12 +174,15 @@ def _iteration_info(
     """The per-loop record the reference prints (diff vs previous weights,
     zapped fraction — iterative_cleaner.py:127-133); shared by the stepwise
     loop and the fused path's post-hoc derivation so the two can never
-    diverge."""
+    diverge.  The churn split (newly zapped vs restored) is the forensics
+    view of the same XOR: both are O(nsub*nchan) host ops on the mask."""
     return IterationInfo(
         index=index,
         diff_weights=int(np.sum(new_w != prev_w)),
         rfi_frac=float((new_w.size - np.count_nonzero(new_w)) / new_w.size),
         duration_s=duration_s,
+        n_new_zaps=int(np.sum((new_w == 0) & (prev_w != 0))),
+        n_unzapped=int(np.sum((new_w != 0) & (prev_w == 0))),
     )
 
 
@@ -242,8 +273,18 @@ def clean_cube(
             maybe_clean_sharded,
         )
 
-        sharded = maybe_clean_sharded(D, w0, cfg, want_residual)
+        from iterative_cleaner_tpu.obs import events as _events
+        from iterative_cleaner_tpu.obs.tracing import (
+            compile_scope as _cscope,
+            shape_bucket_label as _sbl,
+        )
+
+        with _cscope(_sbl(D.shape)):
+            sharded = maybe_clean_sharded(D, w0, cfg, want_residual)
         if sharded is not None:
+            if _events.enabled():
+                _events.emit("clean_route", route="sharded",
+                             shape=list(D.shape))
             # No x64/want_residual axes (maybe_clean_sharded declines both);
             # max_iter/pulse_region are statics of the sharded kernel.
             note_compiled_shape(
@@ -325,18 +366,32 @@ def clean_cube(
             note_compiled_shape(
                 inmemory_route_key((nsub, nchan, nbin), cfg, want_residual))
 
+    from iterative_cleaner_tpu.obs import events, forensics
+    from iterative_cleaner_tpu.obs.tracing import (
+        compile_scope,
+        shape_bucket_label,
+    )
+
     if cfg.fused and chunk_block is None:
         from iterative_cleaner_tpu.backends.jax_backend import run_fused
 
-        out = run_fused(D, w0, cfg, want_residual=want_residual)
+        if events.enabled():
+            events.emit("clean_route", route="fused", shape=list(D.shape))
+        with compile_scope(shape_bucket_label(D.shape)):
+            out = run_fused(D, w0, cfg, want_residual=want_residual)
         test, w_final, loops, done, _x, history = out[:6]
         history = list(history)
         infos = []
         for i in range(1, len(history)):
             info = _iteration_info(i, history[i - 1], history[i])
+            if forensics.attribution_enabled():
+                info.zaps_by_diagnostic = forensics.attribute_zaps(
+                    D, w0, history[i - 1], history[i], cfg)
             infos.append(info)
             if progress is not None:
                 progress(info)
+            if events.enabled():
+                events.emit("iteration", **forensics.iteration_record(info))
         return CleanResult(
             weights=w_final,
             test_results=test,
@@ -345,17 +400,26 @@ def clean_cube(
             iterations=infos,
             history=history,
             residual=out[6] if want_residual else None,
+            termination=forensics.termination_reason(done, history),
         )
 
     if chunk_block is not None:
         from iterative_cleaner_tpu.parallel.chunked import ChunkedJaxCleaner
 
+        if events.enabled():
+            events.emit("clean_route", route="chunked", shape=list(D.shape),
+                        block=chunk_block, why=chunk_why)
         backend = ChunkedJaxCleaner(
             D, w0, cfg, block=chunk_block, keep_residual=want_residual)
     else:
+        if events.enabled():
+            events.emit("clean_route",
+                        route="stepwise" if cfg.backend == "jax" else "numpy",
+                        shape=list(D.shape))
         backend = make_backend(D, w0, cfg)
     state = LoopState.start(w0)
-    state.run(backend, cfg.max_iter, progress=progress)
+    with compile_scope(shape_bucket_label(D.shape)):
+        state.run(backend, cfg.max_iter, progress=progress)
 
     residual = None
     if want_residual:

@@ -204,10 +204,13 @@ def process_archive(
 
     if not cfg.quiet:
         print("Total number of profiles: %s" % archive.weights.size)
-    from iterative_cleaner_tpu.utils.tracing import profile_trace
+    from iterative_cleaner_tpu.obs import events
+    from iterative_cleaner_tpu.obs.tracing import profile_trace
 
     cleaner = SurgicalCleaner(cfg)
-    with profile_trace(cfg.trace_dir):
+    with profile_trace(cfg.trace_dir), \
+            events.span("clean_archive", path=path,
+                        shape=list(archive.data.shape)):
         out: SurgicalOutput = cleaner.clean(archive, progress=progress)
     res = out.result
 

@@ -1,0 +1,200 @@
+"""Trace context + the JSON-lines telemetry event log.
+
+A ``trace_id`` is minted at every entry point (CLI run, POST /jobs, online
+session) and threaded through every layer a request crosses — scheduler
+admission, worker dispatch, chunked/sharded execution, online block ingest
+— so an operator can reconstruct any job's full path from one grep of the
+event log.  Propagation is explicit where work crosses threads (the id
+rides on the Job / session manifest) and implicit within a thread (a
+contextvar, set by :func:`trace_scope` / :func:`span`, that nested
+:func:`emit` calls inherit).
+
+The sink is a JSON-lines file: ``--telemetry out.jsonl`` on the CLI and
+the serving daemon, or the ``ICT_TELEMETRY`` environment variable.  One
+event per line: ``{"ts": ..., "event": ..., "trace_id": ...,
+"span_id": ..., ...fields}``.  When no sink is configured every hook here
+is a cheap no-op — the hot path pays a single ``if``.
+
+Ids are random hex (16 chars trace / 8 chars span), not time-derived:
+they only need to be grep-unique within one log, and minting must stay
+nanosecond-cheap on the disabled path too (POST /jobs echoes the id even
+with the log off).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import sys
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    trace_id: str
+    span_id: str = ""
+
+
+_current: contextvars.ContextVar[TraceContext | None] = contextvars.ContextVar(
+    "ict_trace_ctx", default=None)
+
+_UNSET = object()
+_explicit = _UNSET          # configure() override; _UNSET -> read the env
+_lock = threading.Lock()
+_fh = None                  # cached append handle for the active path
+_fh_path: str | None = None
+_warned = False
+_retry_at = 0.0             # sink-failure backoff deadline (monotonic)
+
+#: After a failed sink write, drop events for this long, then try again —
+#: transient disk trouble (brief ENOSPC, a remounted log volume) must not
+#: silence a weeks-lived daemon's event log forever.
+SINK_RETRY_S = 60.0
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:8]
+
+
+def configure(path: str | None) -> None:
+    """Point the event log at ``path`` (None/'' disables and, for tests,
+    returns to honoring ``ICT_TELEMETRY``).  The file is opened lazily in
+    append mode on first emit."""
+    global _explicit, _fh, _fh_path, _retry_at
+    with _lock:
+        _explicit = path if path else _UNSET
+        _retry_at = 0.0
+        if _fh is not None and _fh_path != _sink_path_locked():
+            try:
+                _fh.close()
+            except OSError:
+                pass
+            _fh = None
+            _fh_path = None
+
+
+def _sink_path_locked() -> str | None:
+    if _explicit is _UNSET:
+        return os.environ.get("ICT_TELEMETRY") or None
+    return _explicit
+
+
+def enabled() -> bool:
+    """Whether an event sink is active (the one check every hook makes)."""
+    if _explicit is _UNSET:
+        return bool(os.environ.get("ICT_TELEMETRY"))
+    return _explicit is not None
+
+
+def current() -> TraceContext | None:
+    return _current.get()
+
+
+def current_trace_id() -> str:
+    ctx = _current.get()
+    return ctx.trace_id if ctx is not None else ""
+
+
+@contextlib.contextmanager
+def trace_scope(trace_id: str, span_id: str = ""):
+    """Bind a trace context to this thread/task so nested :func:`emit` and
+    :func:`span` calls inherit it — the bridge for ids that crossed a
+    thread boundary riding on a Job or session manifest."""
+    token = _current.set(TraceContext(trace_id, span_id))
+    try:
+        yield
+    finally:
+        _current.reset(token)
+
+
+def emit(event: str, trace_id: str | None = None, span_id: str | None = None,
+         **fields) -> None:
+    """Append one event line.  No-op without a sink; never raises — a
+    failing sink (full disk, yanked directory) drops events for
+    ``SINK_RETRY_S`` with one stderr warning, then tries again, rather
+    than failing the clean it was observing or going silent forever."""
+    global _fh, _fh_path, _warned, _retry_at
+    if not enabled():
+        return
+    ctx = _current.get()
+    rec = {
+        "ts": round(time.time(), 6),
+        "event": event,
+        "trace_id": trace_id if trace_id is not None
+        else (ctx.trace_id if ctx else ""),
+        "span_id": span_id if span_id is not None
+        else (ctx.span_id if ctx else ""),
+    }
+    rec.update(fields)
+    line = json.dumps(rec, default=str) + "\n"
+    with _lock:
+        path = _sink_path_locked()
+        if path is None:
+            return
+        if _retry_at and time.monotonic() < _retry_at:
+            return
+        try:
+            if _fh is None or _fh_path != path:
+                if _fh is not None:
+                    _fh.close()
+                _fh = open(path, "a")
+                _fh_path = path
+            _fh.write(line)
+            _fh.flush()
+            _retry_at = 0.0
+        except OSError as exc:
+            _retry_at = time.monotonic() + SINK_RETRY_S
+            try:
+                if _fh is not None:
+                    _fh.close()
+            except OSError:
+                pass
+            _fh = None
+            _fh_path = None
+            if not _warned:
+                _warned = True
+                print(f"warning: telemetry sink {path!r} failed ({exc}); "
+                      f"dropping events, retrying every {SINK_RETRY_S:.0f}s",
+                      file=sys.stderr)
+
+
+@contextlib.contextmanager
+def span(name: str, trace_id: str | None = None, **fields):
+    """Emit ``<name>_start`` / ``<name>_end`` events around a block and bind
+    the span's context: nested :func:`emit` calls inherit the trace_id and
+    this span's id as their ``span_id``, and nested *spans* record it as
+    their ``parent_span_id`` (the span's own start/end events carry both).
+    The end event records ``duration_s`` and ``status`` ("ok"/"error").
+    Fast no-op when the sink is disabled."""
+    if not enabled():
+        yield
+        return
+    ctx = _current.get()
+    tid = trace_id if trace_id is not None else (ctx.trace_id if ctx else
+                                                new_trace_id())
+    sid = new_span_id()
+    parent = ctx.span_id if ctx else ""
+    emit(f"{name}_start", trace_id=tid, span_id=sid,
+         parent_span_id=parent, **fields)
+    token = _current.set(TraceContext(tid, sid))
+    t0 = time.perf_counter()
+    status = "ok"
+    try:
+        yield
+    except BaseException:
+        status = "error"
+        raise
+    finally:
+        _current.reset(token)
+        emit(f"{name}_end", trace_id=tid, span_id=sid,
+             parent_span_id=parent, status=status,
+             duration_s=round(time.perf_counter() - t0, 6))

@@ -1,0 +1,94 @@
+"""Prometheus text exposition over the :mod:`.tracing` registries.
+
+The serving daemon's ``/metrics`` renders this (the legacy raw-JSON
+snapshot moved to ``/metrics.json``).  Three metric classes:
+
+- **flat counters/gauges** — every registry entry verbatim under an
+  ``ict_`` prefix, so the established internal names stay the operator
+  vocabulary: ``ict_service_load_s`` (total seconds, counter),
+  ``ict_service_load_n`` (count, counter), ``ict_service_load_err_n``
+  (failures, counter), ``ict_service_load_max_s`` (worst single
+  occurrence, gauge), plus the plain event counters
+  (``ict_service_jobs_done`` …).  Every ``_s`` total has a matching
+  ``_n`` count by construction (observe_phase writes both under one
+  lock) — pinned by tests/test_observability.py.
+- **histograms** — one family ``ict_phase_duration_seconds`` labeled by
+  ``phase``, cumulative log2 buckets (``le`` bounds from
+  tracing.HIST_BOUNDS) with ``_sum``/``_count`` taken from the same
+  ``_s``/``_n`` counters.
+- **labeled counters** — ``ict_<family>{label="..."}`` from
+  tracing.count_labeled (compiles / compile seconds per ``shape_bucket``,
+  jobs per ``route``, …).
+"""
+
+from __future__ import annotations
+
+from iterative_cleaner_tpu.obs import tracing
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _fmt(value: float) -> str:
+    """Prometheus sample value: integral floats render as ints (bucket
+    counts must not read as '3.0' in a strict parser)."""
+    if float(value).is_integer():
+        return str(int(value))
+    return repr(float(value))
+
+
+def _escape(value: str) -> str:
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels(pairs) -> str:
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in pairs)
+    return "{" + inner + "}" if inner else ""
+
+
+def render_prometheus() -> str:
+    """One consistent scrape of every registry, Prometheus text format."""
+    counters, labeled, hists = tracing.registry_snapshot()
+    lines: list[str] = []
+
+    # --- phase latency histograms (cumulative buckets, label: phase) ---
+    if hists:
+        lines.append("# HELP ict_phase_duration_seconds per-phase latency, "
+                     "fixed log2 buckets")
+        lines.append("# TYPE ict_phase_duration_seconds histogram")
+        for phase, buckets in hists.items():
+            cum = 0
+            for bound, n in zip(tracing.HIST_BOUNDS, buckets):
+                cum += n
+                lines.append(
+                    "ict_phase_duration_seconds_bucket"
+                    + _labels([("phase", phase), ("le", repr(bound))])
+                    + f" {cum}")
+            cum += buckets[-1]
+            lines.append(
+                "ict_phase_duration_seconds_bucket"
+                + _labels([("phase", phase), ("le", "+Inf")]) + f" {cum}")
+            lines.append(
+                "ict_phase_duration_seconds_sum"
+                + _labels([("phase", phase)])
+                + f" {_fmt(counters.get(f'{phase}_s', 0.0))}")
+            lines.append(
+                "ict_phase_duration_seconds_count"
+                + _labels([("phase", phase)])
+                + f" {_fmt(counters.get(f'{phase}_n', 0.0))}")
+
+    # --- flat counters / gauges, internal names preserved ---
+    for name, value in counters.items():
+        kind = "gauge" if name.endswith("_max_s") else "counter"
+        lines.append(f"# TYPE ict_{name} {kind}")
+        lines.append(f"ict_{name} {_fmt(value)}")
+
+    # --- labeled counters (grouped per family for one TYPE line) ---
+    seen_families: set[str] = set()
+    for (family, label_pairs), value in labeled.items():
+        if family not in seen_families:
+            seen_families.add(family)
+            lines.append(f"# TYPE ict_{family} counter")
+        lines.append(f"ict_{family}{_labels(label_pairs)} {_fmt(value)}")
+
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,252 @@
+"""Process-global metrics registry + profiling hooks.
+
+Grown from ``utils/tracing.py`` (PR 3): the monotonic counter dict that the
+serving daemon's ``/metrics.json`` reports is still here, unchanged in
+shape, but every :func:`observe_phase` now also lands in a fixed
+log2-bucket latency histogram (rendered in Prometheus text form by
+:mod:`.metrics` — the max-only tail gauge was the cheapest tail statistic,
+a histogram is the honest one), failures get their own ``<name>_err_n``
+counter, and a small labeled-counter registry carries the dimensions flat
+names cannot (route, shape bucket).  ``observe_phase`` keeps the Prometheus
+summary convention (``<name>_s`` total seconds + ``<name>_n`` count), which
+is what the per-stage accounting of astronomical pipelines needs
+("Pipeline Collector", arXiv:1807.05733): mean stage latency is
+``load_s / load_n``.
+
+Everything is process-global on purpose: every layer (driver, batch
+dispatch, service worker, online session) accounts into one place without
+plumbing a registry object through call signatures.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+
+@contextlib.contextmanager
+def profile_trace(trace_dir: str | None):
+    """jax.profiler trace around a block when trace_dir is set (view with
+    tensorboard or xprof); no-op otherwise."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+# --- the registries (one lock: a /metrics scrape sees a consistent cut) ---
+
+#: Fixed log2 histogram bucket upper bounds (seconds): 16 finite bounds,
+#: 2^-10 (~0.98 ms) through 2^5 (32 s), plus the implicit +Inf bucket.
+#: Fixed, not adaptive: every phase shares one bucket layout so cross-phase
+#: comparison and the Prometheus exposition stay trivial, and bucketing is
+#: a 16-entry linear scan — no histogram state to size.
+HIST_BOUNDS: tuple[float, ...] = tuple(2.0 ** e for e in range(-10, 6))
+
+_counters: dict[str, float] = {}
+_labeled: dict[tuple[str, tuple[tuple[str, str], ...]], float] = {}
+_hists: dict[str, list[int]] = {}
+_counters_lock = threading.Lock()
+
+
+def _bucket_index(seconds: float) -> int:
+    """Index of the first bound >= seconds (len(HIST_BOUNDS) = the +Inf
+    bucket); a linear scan over the 16 finite bounds."""
+    for i, bound in enumerate(HIST_BOUNDS):
+        if seconds <= bound:
+            return i
+    return len(HIST_BOUNDS)
+
+
+def count(name: str, inc: float = 1.0) -> None:
+    """Add ``inc`` to the process-global counter ``name``."""
+    with _counters_lock:
+        _counters[name] = _counters.get(name, 0.0) + inc
+
+
+def count_labeled(family: str, labels: dict[str, str], inc: float = 1.0) -> None:
+    """Add ``inc`` to the labeled counter ``family{labels}`` — the register
+    for dimensions a flat name cannot carry (route, shape bucket).  Label
+    sets are expected to stay low-cardinality (shape classes, route names);
+    the registry is a plain dict, so an unbounded label value would grow it
+    without bound."""
+    key = (family, tuple(sorted((str(k), str(v)) for k, v in labels.items())))
+    with _counters_lock:
+        _labeled[key] = _labeled.get(key, 0.0) + inc
+
+
+def observe_phase(name: str, seconds: float, error: bool = False) -> None:
+    """Record one completed phase: total seconds + occurrence count + the
+    worst single occurrence (``<name>_max_s``) + one log2 histogram bucket.
+    ``error=True`` additionally bumps ``<name>_err_n`` — failed occurrences
+    still count in ``_n``/``_s`` (a failing load is still a load the
+    operator wants in the latency accounting) but become visible as a
+    failure *rate* on ``/metrics``."""
+    with _counters_lock:
+        _counters[f"{name}_s"] = _counters.get(f"{name}_s", 0.0) + seconds
+        _counters[f"{name}_n"] = _counters.get(f"{name}_n", 0.0) + 1.0
+        if error:
+            _counters[f"{name}_err_n"] = _counters.get(f"{name}_err_n", 0.0) + 1.0
+        key = f"{name}_max_s"
+        if seconds > _counters.get(key, 0.0):
+            _counters[key] = seconds
+        hist = _hists.get(name)
+        if hist is None:
+            hist = _hists[name] = [0] * (len(HIST_BOUNDS) + 1)
+        hist[_bucket_index(seconds)] += 1
+
+
+@contextlib.contextmanager
+def phase(name: str):
+    """Time a block into :func:`observe_phase`.  Exceptions still count in
+    the totals (see observe_phase) AND bump ``<name>_err_n``, so failure
+    rates are first-class on ``/metrics`` instead of masquerading as
+    successes."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    except BaseException:
+        observe_phase(name, time.perf_counter() - t0, error=True)
+        raise
+    else:
+        observe_phase(name, time.perf_counter() - t0)
+
+
+def counters_snapshot() -> dict[str, float]:
+    """Point-in-time copy of every flat counter, sorted by name (stable
+    JSON — the ``/metrics.json`` payload)."""
+    with _counters_lock:
+        return dict(sorted(_counters.items()))
+
+
+def labeled_snapshot() -> dict[tuple[str, tuple[tuple[str, str], ...]], float]:
+    """Point-in-time copy of the labeled-counter registry."""
+    with _counters_lock:
+        return dict(sorted(_labeled.items()))
+
+
+def histograms_snapshot() -> dict[str, list[int]]:
+    """Point-in-time copy of every phase histogram (per-bucket counts, NOT
+    cumulative; the Prometheus renderer accumulates)."""
+    with _counters_lock:
+        return {k: list(v) for k, v in sorted(_hists.items())}
+
+
+def registry_snapshot() -> tuple[dict, dict, dict]:
+    """(counters, labeled, histograms) under ONE lock hold — the scrape
+    path's view, so a histogram's +Inf bucket can never disagree with its
+    ``_n`` counter mid-observation."""
+    with _counters_lock:
+        return (
+            dict(sorted(_counters.items())),
+            dict(sorted(_labeled.items())),
+            {k: list(v) for k, v in sorted(_hists.items())},
+        )
+
+
+def snapshot(prefix: str = "") -> dict[str, float]:
+    """:func:`counters_snapshot`, optionally filtered to one subsystem's
+    ``prefix`` — the before/after idiom tests use so counter state from one
+    case never bleeds into another's assertions (delta = snapshot() minus an
+    earlier snapshot(), no global reset needed mid-process)."""
+    snap = counters_snapshot()
+    if not prefix:
+        return snap
+    return {k: v for k, v in snap.items() if k.startswith(prefix)}
+
+
+def delta(before: dict[str, float], key: str) -> float:
+    """Counter movement since a :func:`snapshot`; missing keys read 0."""
+    return counters_snapshot().get(key, 0.0) - before.get(key, 0.0)
+
+
+def reset_counters() -> None:
+    """Zero every registry (tests only — production counters are cumulative
+    for the life of the process, like any scrape target)."""
+    with _counters_lock:
+        _counters.clear()
+        _labeled.clear()
+        _hists.clear()
+
+
+# --- compile accounting (utils/compile_cache.py + the jax monitoring bus) ---
+
+_tls = threading.local()
+_listener_installed = False
+
+
+def shape_bucket_label(shape) -> str:
+    """Canonical shape-bucket label: '8x16x64' (leading int dims only)."""
+    return "x".join(str(int(v)) for v in shape)
+
+
+@contextlib.contextmanager
+def compile_scope(shape_bucket: str):
+    """Attribute any jax backend compile that fires inside this block to
+    ``shape_bucket`` (thread-local: jit compiles run synchronously on the
+    calling thread, so the monitoring callback fires in-scope)."""
+    prev = getattr(_tls, "shape_bucket", "")
+    _tls.shape_bucket = shape_bucket
+    try:
+        yield
+    finally:
+        _tls.shape_bucket = prev
+
+
+def install_compile_listener() -> bool:
+    """Register a jax.monitoring listener that accounts real backend
+    compiles (count + seconds, per shape bucket when a
+    :func:`compile_scope` is active) and persistent-compilation-cache
+    events into this registry.  Idempotent; best-effort — a drifted private
+    monitoring surface just means compiles stay unaccounted.  Only call on
+    the JAX path (it imports jax)."""
+    global _listener_installed
+    if _listener_installed:
+        return True
+    try:
+        from jax._src import monitoring
+    except Exception:  # noqa: BLE001 — private-API drift tolerated
+        return False
+
+    def _on_duration(name, dur, **kw):
+        if name.endswith("backend_compile_duration"):
+            observe_phase("jax_compile", dur)
+            bucket = getattr(_tls, "shape_bucket", "") or "unscoped"
+            count_labeled("compiles_total", {"shape_bucket": bucket})
+            count_labeled("compile_seconds_total", {"shape_bucket": bucket},
+                          dur)
+
+    def _on_event(name, **kw):
+        # e.g. '/jax/compilation_cache/cache_hits' — the persistent on-disk
+        # cache's own accounting, surfaced next to ours.
+        if "/compilation_cache/" in name:
+            count(f"persistent_{name.rsplit('/', 1)[-1]}")
+
+    try:
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        monitoring.register_event_listener(_on_event)
+    except Exception:  # noqa: BLE001 — accounting is opportunistic
+        return False
+    _listener_installed = True
+    return True
+
+
+class StepTimer:
+    """Wall-clock per iteration, reported through the progress callback.
+    perf_counter: monotonic (no negative laps on wall-clock steps) and
+    high-resolution (no 0.0 laps on coarse system clocks)."""
+
+    def __init__(self) -> None:
+        self._t0 = time.perf_counter()
+        self.durations: list[float] = []
+
+    def lap(self) -> float:
+        now = time.perf_counter()
+        dt = now - self._t0
+        self._t0 = now
+        self.durations.append(dt)
+        return dt

@@ -35,8 +35,8 @@ import numpy as np
 
 from iterative_cleaner_tpu.config import CleanConfig
 from iterative_cleaner_tpu.core.cleaner import LoopState
+from iterative_cleaner_tpu.obs import events, tracing
 from iterative_cleaner_tpu.online.state import CleanState, SessionMeta
-from iterative_cleaner_tpu.utils import tracing
 
 #: Alert payloads list at most this many newly-zapped (subint, channel)
 #: pairs; beyond it only the count is reported (``truncated: true``) — an
@@ -133,6 +133,16 @@ class OnlineSession:
             alert.latency_s = time.perf_counter() - t0
         tracing.count("online_blocks_ingested")
         tracing.count("online_zap_alerts", alert.n_new_zaps)
+        if events.enabled():
+            # Inherits the session's trace context (service/sessions.py and
+            # the --follow driver bind it around ingest).
+            events.emit("online_block", block_index=alert.block_index,
+                        subint_lo=alert.subint_lo, subint_hi=alert.subint_hi,
+                        n_new_zaps=alert.n_new_zaps,
+                        provisional_rfi_frac=round(
+                            alert.provisional_rfi_frac, 6),
+                        pass_converged=alert.pass_converged,
+                        latency_s=round(alert.latency_s, 6))
         self.blocks_ingested += 1
         self.alerts.append(alert)
         return alert

@@ -40,6 +40,10 @@ class BatchItem:
     converged: bool = False
     rfi_frac: float = 0.0
     error: str | None = None
+    # Convergence forensics (filled only when the dispatcher ran with
+    # want_history — the serving daemon's per-job timeline source).
+    iterations: list | None = None      # list[IterationInfo]
+    termination: str = ""               # "fixed_point" | "cycle" | "max_iter"
 
 
 def _load_and_preprocess(path: str):
@@ -68,17 +72,32 @@ def finalize_weights(final_w, cfg) -> tuple[np.ndarray, float]:
     return final_w, rfi_frac
 
 
-def _finish_bucket(items, idxs, Db, w0b, cfg, mesh, on_item=None) -> None:
+def _finish_bucket(items, idxs, Db, w0b, cfg, mesh, on_item=None,
+                   want_history=False) -> None:
     """Run one stacked bucket on the mesh and write results into its
     BatchItems (shared by the all-at-once and streaming dispatchers).
     ``on_item(i, item)`` fires per finished archive — the streaming driver
     emits outputs there and releases the item's host arrays, which is what
-    makes its memory bound real."""
+    makes its memory bound real.  ``want_history`` additionally fetches the
+    per-archive mask histories and derives each item's per-iteration
+    forensics records + termination reason (the serving daemon's
+    ``GET /jobs/<id>/trace`` source; off by default — extra host traffic)."""
+    from iterative_cleaner_tpu.obs import forensics
+    from iterative_cleaner_tpu.obs.tracing import (
+        compile_scope,
+        shape_bucket_label,
+    )
+
     # The key mirrors batched_fused_clean's static-arg surface; shared with
     # the service warm pool so a pool-warmed batch shape is recognised here
     # (see compile_cache.batch_route_key for the x64 note).
     note_compiled_shape(batch_route_key(Db.shape, cfg))
-    test_b, w_b, loops_b, done_b = sharded_clean(Db, w0b, cfg, mesh)
+    with compile_scope(shape_bucket_label(Db.shape)):
+        if want_history:
+            test_b, w_b, loops_b, done_b, x_b, hist_b = sharded_clean(
+                Db, w0b, cfg, mesh, want_history=True)
+        else:
+            test_b, w_b, loops_b, done_b = sharded_clean(Db, w0b, cfg, mesh)
     for j, i in enumerate(idxs):
         item = items[i]
         final_w, item.rfi_frac = finalize_weights(w_b[j], cfg)
@@ -86,6 +105,16 @@ def _finish_bucket(items, idxs, Db, w0b, cfg, mesh, on_item=None) -> None:
         item.test_results = test_b[j]
         item.loops = int(loops_b[j])
         item.converged = bool(done_b[j])
+        if want_history and hist_b is not None:
+            from iterative_cleaner_tpu.core.cleaner import _iteration_info
+
+            hist = hist_b[j][: int(x_b[j]) + 1]
+            item.iterations = [
+                _iteration_info(k, hist[k - 1], hist[k])
+                for k in range(1, len(hist))
+            ]
+            item.termination = forensics.termination_reason(
+                item.converged, hist)
         if on_item is not None:
             on_item(i, item)
 

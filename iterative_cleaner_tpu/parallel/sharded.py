@@ -100,17 +100,24 @@ def sharded_clean(
     w0b: np.ndarray,
     cfg: CleanConfig,
     mesh: Mesh,
+    want_history: bool = False,
 ):
     """Clean a same-shape batch of preprocessed cubes on a device mesh.
 
     Returns host arrays: (test (a,s,c), weights (a,s,c), loops (a,),
-    converged (a,)).  The mesh may span processes (multi-controller SPMD):
-    every participating process must call this with the same batch, and
-    each gets the full host-side result back.
+    converged (a,)) — plus, with ``want_history`` (the serving daemon's
+    convergence-forensics fetch, docs/OBSERVABILITY.md), the per-archive
+    iteration counts (a,) and the mask-history ring buffers
+    (a, max_iter+1, s, c); rows 0..x[j] of archive j's buffer are populated
+    (row 0 = w0).  History is fetched only on request: it is max_iter+1
+    masks per archive of extra host traffic the default path must not pay.
+    The mesh may span processes (multi-controller SPMD): every
+    participating process must call this with the same batch, and each gets
+    the full host-side result back.
     """
     Db, w0b = shard_batch(Db, w0b, mesh)
     validb = w0b != 0
-    test, w_final, loops, done, _x, _r, _hist = batched_fused_clean(
+    test, w_final, loops, done, x, _r, hist = batched_fused_clean(
         Db,
         w0b,
         validb,
@@ -119,4 +126,21 @@ def sharded_clean(
         max_iter=int(cfg.max_iter),
         pulse_region=tuple(cfg.pulse_region),
     )
+    if want_history:
+        if all(v.is_fully_addressable
+               for v in (test, w_final, loops, done, x, hist)):
+            # Fetch only the populated ring-buffer prefix: rows past the
+            # batch's largest iteration count are zero padding the host
+            # slice (hist_b[j][:x_b[j]+1]) would discard anyway, and at
+            # max_iter >> loops they dominate the device->host transfer.
+            hist = hist[:, : int(x.max()) + 1]
+            return _to_host(test, w_final, loops, done, x, hist)
+        # Multi-controller mesh: the history fetch is driven by PER-PROCESS
+        # telemetry state (ICT_TELEMETRY/ICT_FORENSICS can differ across
+        # hosts), and a process-allgather whose pytree differs between
+        # hosts deadlocks every participant — so on a process-spanning
+        # mesh the forensics fetch degrades to "no history" rather than
+        # extending the same-on-every-process contract to env vars.
+        out = _to_host(test, w_final, loops, done)
+        return (*out, None, None)
     return _to_host(test, w_final, loops, done)

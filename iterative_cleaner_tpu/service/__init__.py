@@ -9,8 +9,13 @@ invocation.  Real RFI-mitigation deployments are continuous pipelines
 - :mod:`.scheduler` — shape-bucketed admission queue (dp-slice / deadline)
 - :mod:`.worker`    — fault-isolated dispatch (retry, oracle fallback)
 - :mod:`.pool`      — warm executable pool (startup precompile)
-- :mod:`.api`       — stdlib-HTTP JSON endpoints (/jobs, /healthz, /metrics)
+- :mod:`.api`       — stdlib-HTTP endpoints (/jobs, /jobs/<id>/trace,
+                      /healthz, Prometheus /metrics, legacy /metrics.json)
 - :mod:`.daemon`    — lifecycle + the ``ict-serve`` CLI
+
+Observability (obs/ package, docs/OBSERVABILITY.md): every job carries a
+telemetry trace_id from submission through dispatch and per-iteration
+forensics; ``--telemetry`` appends the JSON-lines event log.
 
 The service is routing, not math: masks stay bit-identical to the numpy
 oracle on every served route (the sharded bucket dispatch is pinned by

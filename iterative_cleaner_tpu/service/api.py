@@ -1,20 +1,31 @@
 """JSON HTTP surface over stdlib ``http.server`` — zero new dependencies.
 
-Endpoints (JSON unless noted; full reference in docs/SERVING.md):
+Endpoints (JSON unless noted; full reference in docs/SERVING.md and
+docs/OBSERVABILITY.md):
 
 - ``POST /jobs``            ``{"path": "/abs/archive.npz"}`` -> 202 + job
+                            (the response and its ``X-ICT-Trace`` header
+                            carry the job's telemetry ``trace_id``)
 - ``GET  /jobs/<id>``       job manifest (state machine in service/jobs.py)
+- ``GET  /jobs/<id>/trace`` convergence forensics: trace id, termination
+                            reason, per-iteration timeline
 - ``POST /sessions``        open a streaming session (body: SessionMeta
                             fields + optional out_path/alert_iters)
 - ``POST /sessions/<id>/blocks``  one subint block as an NPZ body
                             (online/blocks.py) -> provisional zap alert
 - ``POST /sessions/<id>/finish``  canonical finalize -> final manifest
 - ``GET  /sessions/<id>``   session manifest
-- ``GET  /healthz``         liveness + backend mode + queue depths
-- ``GET  /metrics``         the process-global per-phase counters
-                            (utils/tracing.py: ``*_s`` total seconds,
-                            ``*_n`` counts, ``*_max_s`` worst single
-                            occurrence, ``service_*``/``online_*`` events)
+- ``GET  /healthz``         liveness + backend mode + uptime/version +
+                            queue/spool depths (the load-balancer drain view)
+- ``GET  /metrics``         Prometheus text exposition (obs/metrics.py):
+                            per-phase log2 latency histograms, counters,
+                            compile/cache accounting with shape-bucket and
+                            route labels
+- ``GET  /metrics.json``    the legacy raw-JSON counter snapshot
+                            (obs/tracing.py: ``*_s`` total seconds, ``*_n``
+                            counts, ``*_err_n`` failures, ``*_max_s`` worst
+                            single occurrence, ``service_*``/``online_*``
+                            events)
 
 ThreadingHTTPServer: each request gets a thread, so a slow client cannot
 stall the poll loop; all handlers only touch thread-safe service surfaces
@@ -29,7 +40,8 @@ import os
 import sys
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from iterative_cleaner_tpu.utils import tracing
+from iterative_cleaner_tpu.obs import metrics as obs_metrics
+from iterative_cleaner_tpu.obs import tracing
 
 #: Default per-socket-read timeout; ``ICT_HTTP_TIMEOUT_S`` overrides — a
 #: streaming client uploading multi-hundred-MB blocks over a slow link
@@ -78,8 +90,20 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if isinstance(payload, dict) and payload.get("trace_id"):
+            # Echo the telemetry trace context wherever a payload carries
+            # one, so header-only clients can correlate with the event log.
+            self.send_header("X-ICT-Trace", str(payload["trace_id"]))
         for k, v in (headers or {}).items():
             self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_text(self, code: int, text: str, content_type: str) -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
@@ -100,11 +124,19 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             self._reply(200, service.health())
         elif self.path == "/metrics":
+            self._reply_text(200, obs_metrics.render_prometheus(),
+                             obs_metrics.CONTENT_TYPE)
+        elif self.path == "/metrics.json":
             self._reply(200, tracing.counters_snapshot())
         elif self.path.startswith("/jobs/"):
-            job = service.job(self.path[len("/jobs/"):])
-            if job is None:
-                self._reply(404, {"error": "no such job"})
+            jid, sep, verb = self.path[len("/jobs/"):].partition("/")
+            job = service.job(jid)
+            if job is None or (sep and verb != "trace"):
+                self._reply(404, {"error": "no such job"
+                                  if job is None else
+                                  f"no such route {self.path!r}"})
+            elif sep:
+                self._reply(200, job.trace_dict())
             else:
                 self._reply(200, job.to_dict())
         elif self.path.startswith("/sessions/"):

@@ -30,10 +30,10 @@ import time
 from dataclasses import dataclass, field
 
 from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.obs import events, tracing
 from iterative_cleaner_tpu.service.jobs import TERMINAL, Job, JobSpool
 from iterative_cleaner_tpu.service.scheduler import ShapeBucketScheduler
 from iterative_cleaner_tpu.service.worker import DispatchWorker
-from iterative_cleaner_tpu.utils import tracing
 
 _STOP = object()
 
@@ -66,6 +66,8 @@ class ServeConfig:
     root: str = ""                   # when set, submitted paths must resolve
                                      # under this directory (the non-loopback
                                      # trust boundary)
+    telemetry: str = ""              # JSON-lines event-log path (obs/events);
+                                     # "" = honor ICT_TELEMETRY / disabled
     quiet: bool = False
     clean: CleanConfig = field(
         default_factory=lambda: CleanConfig(backend="jax"))
@@ -80,6 +82,7 @@ class CleaningService:
         self.clean_cfg = serve_cfg.clean
         self.spool = JobSpool(serve_cfg.spool_dir)
         self.mesh = mesh
+        self.started_s = time.time()   # re-stamped at start(); /healthz uptime
         self.backend_mode = self.clean_cfg.backend   # "jax" | "numpy"
         self.bucket_cap = 1
         self.port = serve_cfg.port
@@ -117,7 +120,14 @@ class CleaningService:
             raise
 
     def _start_locked(self) -> None:
+        self.started_s = time.time()
+        if self.serve_cfg.telemetry:
+            events.configure(self.serve_cfg.telemetry)
         if self.backend_mode == "jax":
+            # Compile accounting on /metrics (compiles, compile seconds per
+            # shape bucket, persistent-cache events).  JAX path only: the
+            # numpy service stays jax-import-free.
+            tracing.install_compile_listener()
             # The CLI front-door wedge guard (utils/device_probe.py): a hung
             # probe with indeterminable liveness means the next jax call may
             # hang the daemon — that, and only that, degrades the whole
@@ -252,7 +262,11 @@ class CleaningService:
         path = self._check_root(path)
         from iterative_cleaner_tpu.service.jobs import new_job_id
 
-        job = Job(id=new_job_id(), path=path, submitted_s=time.time())
+        # The trace context is minted HERE, at the entry point, and rides
+        # on the job through every layer (admission, dispatch, iteration
+        # events) — echoed in the 202 response and the X-ICT-Trace header.
+        job = Job(id=new_job_id(), path=path, submitted_s=time.time(),
+                  trace_id=events.new_trace_id())
         # Cap check and insert under ONE lock hold: concurrent POST handler
         # threads must not all pass the check before any of them inserts
         # (the cap is the OOM backpressure — a race would breach it).
@@ -277,6 +291,9 @@ class CleaningService:
                 self._jobs.pop(job.id, None)
             raise
         tracing.count("service_jobs_submitted")
+        if events.enabled():
+            events.emit("job_submitted", trace_id=job.trace_id,
+                        job_id=job.id, path=path)
         self._load_q.put(job)
         return job
 
@@ -315,13 +332,23 @@ class CleaningService:
             self._jobs.pop(job.id, None)
 
     def health(self) -> dict:
+        """Liveness + the drain signals a load balancer needs: uptime,
+        version, and every queue/spool depth (a degraded daemon shows up
+        as depths that only grow)."""
+        from iterative_cleaner_tpu import __version__
+
         with self._jobs_lock:
             open_jobs = sum(1 for j in self._jobs.values()
                             if j.state not in TERMINAL)
         return {
             "status": "ok",
             "backend": self.backend_mode,
+            "version": __version__,
+            "uptime_s": round(time.time() - self.started_s, 3),
             "open_jobs": open_jobs,
+            "load_queue_depth": self._load_q.qsize(),
+            "dispatch_queue_depth": (self.worker.queue_depth()
+                                     if self.worker else 0),
             "bucketed_cubes": (self.scheduler.pending_count()
                                if self.scheduler else 0),
             "bucket_cap": self.bucket_cap,
@@ -428,6 +455,11 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    metavar="NSUBxNCHANxNBIN",
                    help="shape class to precompile at startup (repeatable), "
                         "e.g. --warm 256x1024x1024")
+    p.add_argument("--telemetry", default="", metavar="PATH",
+                   help="append structured telemetry events (trace spans, "
+                        "per-iteration forensics) to PATH as JSON lines "
+                        "(docs/OBSERVABILITY.md; ICT_TELEMETRY env "
+                        "equivalent; default off)")
     p.add_argument("--backend", choices=("numpy", "jax"), default="jax")
     p.add_argument("-c", "--chanthresh", type=float, default=5)
     p.add_argument("-s", "--subintthresh", type=float, default=5)
@@ -479,6 +511,7 @@ def serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
         max_open_jobs=args.max_open_jobs,
         alert_iters=args.alert_iters,
         root=args.root,
+        telemetry=args.telemetry,
         warm_shapes=parse_warm_shapes(args.warm),
         quiet=args.quiet,
         clean=CleanConfig(

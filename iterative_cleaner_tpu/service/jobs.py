@@ -46,9 +46,33 @@ class Job:
     attempts: int = 0               # dispatch attempts (retry accounting)
     served_by: str = ""             # "sharded" | "oracle-fallback"
     shape: list[int] = field(default_factory=list)  # cube shape once decoded
+    trace_id: str = ""              # telemetry trace context (obs/events.py):
+                                    # minted at admission, echoed in every
+                                    # HTTP response and event-log line
+    termination: str = ""           # forensics: fixed_point | cycle | max_iter
+    # Per-iteration forensics records (obs.forensics.iteration_record dicts)
+    # — served by GET /jobs/<id>/trace, EXCLUDED from to_dict so the job
+    # manifest responses stay lean.
+    timeline: list = field(default_factory=list)
 
     def to_dict(self) -> dict:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        d.pop("timeline", None)
+        return d
+
+    def trace_dict(self) -> dict:
+        """The GET /jobs/<id>/trace payload: identity + convergence
+        forensics (per-iteration timeline, termination reason)."""
+        return {
+            "id": self.id,
+            "trace_id": self.trace_id,
+            "state": self.state,
+            "served_by": self.served_by,
+            "loops": self.loops,
+            "converged": self.converged,
+            "termination": self.termination,
+            "timeline": self.timeline,
+        }
 
     @classmethod
     def from_dict(cls, d: dict) -> "Job":
@@ -116,7 +140,10 @@ class JobSpool:
         tmp = f"{p}.part"
         with self._lock:
             with open(tmp, "w") as fh:
-                json.dump(job.to_dict(), fh, indent=1)
+                # The FULL record, timeline included (to_dict trims it for
+                # HTTP responses only): the spool is the durable store the
+                # trace endpoint reads back after a restart.
+                json.dump(dataclasses.asdict(job), fh, indent=1)
                 fh.write("\n")
             os.replace(tmp, p)
 

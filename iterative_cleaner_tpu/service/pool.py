@@ -24,7 +24,7 @@ import sys
 
 import numpy as np
 
-from iterative_cleaner_tpu.utils import tracing
+from iterative_cleaner_tpu.obs import tracing
 from iterative_cleaner_tpu.utils.compile_cache import (
     already_noted,
     batch_route_key,
@@ -70,7 +70,9 @@ class WarmPool:
                 try:
                     Db = np.zeros((bsz, *shape), np.float32)
                     w0b = np.zeros((bsz, *shape[:2]), np.float32)
-                    sharded_clean(Db, w0b, self.cfg, self.mesh)
+                    with tracing.compile_scope(
+                            tracing.shape_bucket_label((bsz, *shape))):
+                        sharded_clean(Db, w0b, self.cfg, self.mesh)
                     compiled += 1
                 except Exception as exc:  # noqa: BLE001 — best-effort, and
                     # per size: one failed compile must neither skip the

@@ -30,8 +30,8 @@ from dataclasses import dataclass
 import numpy as np
 
 from iterative_cleaner_tpu.io.base import Archive
+from iterative_cleaner_tpu.obs import events, tracing
 from iterative_cleaner_tpu.service.jobs import Job
-from iterative_cleaner_tpu.utils import tracing
 
 
 @dataclass
@@ -79,6 +79,9 @@ class ShapeBucketScheduler:
         entry = Entry(job=job, archive=archive, D=D, w0=w0,
                       arrived_s=time.monotonic())
         job.shape = list(D.shape)
+        if events.enabled():
+            events.emit("admission", trace_id=job.trace_id, job_id=job.id,
+                        shape=list(D.shape))
         flush = None
         with self._lock:
             group = self._buckets.setdefault(tuple(D.shape), [])

@@ -32,6 +32,7 @@ import threading
 import time
 
 from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.obs import events, tracing
 from iterative_cleaner_tpu.online.blocks import decode_block
 from iterative_cleaner_tpu.online.session import (
     DEFAULT_ALERT_ITERS,
@@ -39,7 +40,6 @@ from iterative_cleaner_tpu.online.session import (
 )
 from iterative_cleaner_tpu.online.state import SessionMeta
 from iterative_cleaner_tpu.service.jobs import new_job_id
-from iterative_cleaner_tpu.utils import tracing
 
 _ID_RE = re.compile(r"^[0-9]{13}-[0-9a-f]{8}$")
 _BLOCK_RE = re.compile(r"^block_(\d{5,})\.npz$")
@@ -68,6 +68,7 @@ class SessionManager:
         os.makedirs(root, exist_ok=True)
         self._live: dict[str, OnlineSession] = {}
         self._out_paths: dict[str, str] = {}
+        self._trace_ids: dict[str, str] = {}   # telemetry context per session
         self._lock = threading.Lock()          # the maps
         self._pass_lock = threading.Lock()     # device passes serialize
         self._locks: dict[str, threading.Lock] = {}  # per-session ordering
@@ -112,6 +113,10 @@ class SessionManager:
         if iters < 1:
             raise ValueError(f"alert_iters must be >= 1, got {iters}")
         sid = new_job_id()
+        # Streaming sessions are an entry point: the trace context is
+        # minted at open, persisted in meta.json (so a restarted daemon
+        # keeps the same trace), and echoed in every manifest response.
+        trace_id = events.new_trace_id()
         d = os.path.join(self.root, sid)
         os.makedirs(d, exist_ok=True)
         self._write_json(os.path.join(d, "meta.json"), {
@@ -119,13 +124,18 @@ class SessionManager:
             "out_path": out_path,
             "alert_iters": iters,
             "created_s": time.time(),
+            "trace_id": trace_id,
         })
         with self._lock:
             self._live[sid] = OnlineSession(
                 meta, self._cfg(), alert_iters=iters)
             if out_path:
                 self._out_paths[sid] = out_path
+            self._trace_ids[sid] = trace_id
         tracing.count("online_sessions_opened")
+        if events.enabled():
+            events.emit("session_opened", trace_id=trace_id, session_id=sid,
+                        nchan=meta.nchan, nbin=meta.nbin)
         return self.manifest(sid)
 
     def _materialize(self, sid: str) -> OnlineSession:
@@ -164,7 +174,12 @@ class SessionManager:
             out = saved.get("out_path")
             if out:
                 self._out_paths.setdefault(sid, out)
+            self._trace_ids.setdefault(sid, saved.get("trace_id", ""))
         return live
+
+    def _trace_id(self, sid: str) -> str:
+        with self._lock:
+            return self._trace_ids.get(sid, "")
 
     def add_block(self, sid: str, payload: bytes) -> dict:
         with self._session_lock(sid):
@@ -179,11 +194,13 @@ class SessionManager:
             idx = session.blocks_ingested
             p = os.path.join(d, f"block_{idx:05d}.npz")
             tmp = f"{p}.part"
-            with self._pass_lock:
+            with self._pass_lock, events.trace_scope(self._trace_id(sid)):
                 # The spooled copy lands only after ingest ACCEPTED the
                 # block (ingest rolls its slab append back on any failure),
                 # so spool and resident state can never diverge: crash
                 # after ingest loses only advisory provisional state.
+                # The trace scope threads the session's trace_id into the
+                # ingest pass's per-block / per-iteration telemetry events.
                 with open(tmp, "wb") as fh:
                     fh.write(payload)
                 try:
@@ -206,7 +223,9 @@ class SessionManager:
                 raise ValueError(f"session {sid} has no blocks to finalize")
             session.cfg = self._cfg()   # demotion reaches finalize too
             d = self._dir(sid)
-            with self._pass_lock, tracing.phase("online_finalize"):
+            with self._pass_lock, events.trace_scope(self._trace_id(sid)), \
+                    events.span("session_finalize", session_id=sid), \
+                    tracing.phase("online_finalize"):
                 fin = session.finalize()
             out_path = self._out_paths.get(sid) or os.path.join(d, "final.npz")
             atomic_save(NpzIO(), fin.output.cleaned, out_path)
@@ -236,6 +255,7 @@ class SessionManager:
             "alert_iters": saved.get("alert_iters"),
             "nchan": saved["meta"].get("nchan"),
             "nbin": saved["meta"].get("nbin"),
+            "trace_id": saved.get("trace_id", ""),
         }
         with self._lock:
             live = self._live.get(sid)

@@ -29,9 +29,9 @@ import time
 
 import numpy as np
 
+from iterative_cleaner_tpu.obs import events, forensics, tracing
 from iterative_cleaner_tpu.service.jobs import TERMINAL, Job
 from iterative_cleaner_tpu.service.scheduler import Entry
-from iterative_cleaner_tpu.utils import tracing
 
 _STOP = object()
 
@@ -46,6 +46,10 @@ class DispatchWorker(threading.Thread):
 
     def submit(self, entries: list[Entry]) -> None:
         self._q.put(entries)
+
+    def queue_depth(self) -> int:
+        """Flushed-but-undispatched bucket count (the /healthz drain view)."""
+        return self._q.qsize()
 
     def stop(self) -> None:
         self._q.put(_STOP)
@@ -69,6 +73,10 @@ class DispatchWorker(threading.Thread):
         for e in entries:
             e.job.state = "running"
             svc.spool.save(e.job)
+            if events.enabled():
+                events.emit("dispatch", trace_id=e.job.trace_id,
+                            job_id=e.job.id, bucket_size=len(entries),
+                            backend=svc.backend_mode)
         if svc.backend_mode == "jax":
             err = self._try_sharded(entries)
             if err is None:
@@ -136,7 +144,10 @@ class DispatchWorker(threading.Thread):
             t0 = time.perf_counter()
             try:
                 self._emit(entries[i], item.weights, item.loops,
-                           item.converged, item.rfi_frac, "sharded")
+                           item.converged, item.rfi_frac, "sharded",
+                           iterations=item.iterations,
+                           termination=item.termination,
+                           emit_iteration_events=True)
             except Exception as exc:  # noqa: BLE001 — isolate the one job
                 self._fail(entries[i].job, f"output emission failed: {exc}")
             finally:
@@ -145,38 +156,57 @@ class DispatchWorker(threading.Thread):
                 tracing.observe_phase("service_emit", dt)
 
         t0 = time.perf_counter()
+        ok = False
         try:
             _finish_bucket(items, list(range(len(items))), Db, w0b,
-                           svc.clean_cfg, svc.mesh, on_item=on_item)
+                           svc.clean_cfg, svc.mesh, on_item=on_item,
+                           # The per-job iteration timeline (GET /jobs/<id>/
+                           # trace) costs a history fetch per bucket; pay it
+                           # only when the operator turned forensics on.
+                           want_history=forensics.timeline_enabled())
+            ok = True
         finally:
             # _finish_bucket calls on_item inline, so subtract the emission
             # seconds: the per-stage means (_s/_n) must not double-count
             # I/O time as device-dispatch time.  try/finally so FAILED
             # dispatches count too (tracing.phase's rule) — a backend
-            # incident must not make the mean dispatch latency look healthy.
+            # incident must not make the mean dispatch latency look healthy,
+            # and error=True makes the failure RATE visible on /metrics
+            # (service_dispatch_err_n — the fallback-ladder alarm).
             tracing.observe_phase(
-                "service_dispatch", time.perf_counter() - t0 - emit_s[0])
+                "service_dispatch", time.perf_counter() - t0 - emit_s[0],
+                error=not ok)
 
     def _clean_oracle(self, e: Entry, served_by: str = "oracle-fallback") -> None:
-        """The numpy-oracle route, one job at a time (isolated)."""
+        """The numpy-oracle route, one job at a time (isolated).  Runs
+        inside the job's trace scope, so the core loop's per-iteration
+        telemetry events carry the job's trace_id."""
         from iterative_cleaner_tpu.core.cleaner import clean_cube
         from iterative_cleaner_tpu.parallel.batch import finalize_weights
 
         svc = self.service
         try:
-            with tracing.phase("service_oracle"):
+            with events.trace_scope(e.job.trace_id), \
+                    tracing.phase("service_oracle"):
                 cfg = svc.clean_cfg.replace(backend="numpy")
                 res = clean_cube(e.D, e.w0, cfg)
                 final_w, rfi = finalize_weights(res.weights, cfg)
                 self._emit(e, final_w, res.loops, res.converged, rfi,
-                           served_by)
+                           served_by, iterations=res.iterations,
+                           termination=res.termination)
         except Exception as exc:  # noqa: BLE001 — isolate, report, continue
             self._fail(e.job, str(exc))
 
     # --- terminal transitions ---
 
     def _emit(self, e: Entry, weights, loops, converged, rfi_frac,
-              served_by: str) -> None:
+              served_by: str, iterations=None, termination: str = "",
+              emit_iteration_events: bool = False) -> None:
+        """``iterations``/``termination`` land on the job manifest as the
+        forensics timeline; ``emit_iteration_events`` additionally writes
+        them to the event log (the batched route's post-hoc equivalent of
+        the core loop's inline per-iteration events — the oracle route
+        already emitted inline under its trace scope, so it passes False)."""
         from iterative_cleaner_tpu.driver import atomic_save, output_name
         from iterative_cleaner_tpu.io.base import get_io
         from iterative_cleaner_tpu.models.surgical import apply_output_policy
@@ -191,11 +221,24 @@ class DispatchWorker(threading.Thread):
         job.converged = bool(converged)
         job.rfi_frac = float(rfi_frac)
         job.served_by = served_by
+        job.termination = termination
+        if iterations:
+            job.timeline = [forensics.iteration_record(i) for i in iterations]
+            if emit_iteration_events and events.enabled():
+                for rec in job.timeline:
+                    events.emit("iteration", trace_id=job.trace_id,
+                                job_id=job.id, **rec)
         job.state = "done"
         job.finished_s = time.time()
         svc.spool.save(job)
         svc.retire(job)
         tracing.count("service_jobs_done")
+        tracing.count_labeled("jobs_served_total", {"route": served_by})
+        if events.enabled():
+            events.emit("job_done", trace_id=job.trace_id, job_id=job.id,
+                        served_by=served_by, loops=job.loops,
+                        termination=termination,
+                        rfi_frac=round(job.rfi_frac, 6))
         # Release the decoded cube — steady-state host residency stays
         # bounded by the admission queue, not the job history.
         e.archive = e.D = e.w0 = None
@@ -208,6 +251,9 @@ class DispatchWorker(threading.Thread):
         job.state = "error"
         job.error = msg
         job.finished_s = time.time()
+        if events.enabled():
+            events.emit("job_error", trace_id=job.trace_id, job_id=job.id,
+                        error=msg)
         try:
             self.service.spool.save(job)
             self.service.retire(job)
@@ -218,5 +264,6 @@ class DispatchWorker(threading.Thread):
             print(f"ict-serve: spool save failed for job {job.id}: {exc}",
                   file=sys.stderr)
         tracing.count("service_jobs_error")
-        print(f"ict-serve: job {job.id} ({job.path}) failed: {msg}",
+        trace = f" trace={job.trace_id}" if job.trace_id else ""
+        print(f"ict-serve: job {job.id} ({job.path}){trace} failed: {msg}",
               file=sys.stderr)

@@ -115,6 +115,10 @@ def trim_persistent_cache(path: str | None = None,
                 removed += size
             except OSError:
                 continue
+        if removed:
+            from iterative_cleaner_tpu.obs import tracing
+
+            tracing.count("compile_cache_trim_bytes", float(removed))
         return removed
     except Exception:  # noqa: BLE001 — trimming is opportunistic
         return 0
@@ -222,16 +226,48 @@ def forget_noted(key: tuple) -> None:
     _seen.discard(tuple(key))
 
 
+def _shape_bucket_of(key: tuple) -> str:
+    """The leading integer dims of a route key, as the telemetry shape
+    bucket label ('8x16x64' — batch keys include the batch axis)."""
+    dims = []
+    for v in key:
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            dims.append(str(int(v)))
+        else:
+            break
+    return "x".join(dims) or "scalar"
+
+
 def note_compiled_shape(key: tuple) -> bool:
     """Record a (shape, route-fingerprint) key about to be jit-compiled; drop
     JAX's compilation caches once ``DISTINCT_SHAPE_LIMIT`` distinct keys
     accumulate.  Returns True when a drop happened (the counter restarts).
-    Only call on the JAX path — the numpy backend must stay JAX-import-free."""
-    _seen.add(tuple(key))
+    Only call on the JAX path — the numpy backend must stay JAX-import-free.
+
+    Also the in-process executable cache's accounting hook (obs layer): a
+    re-noted key means the executable set is already live or in flight (a
+    cache *hit* — no NEW compile attributable to this caller; the warm
+    paths note before compiling, so a warmed shape's real dispatch counts
+    as a hit by design), a fresh key means compiles are coming (a *miss*);
+    both land in the process-global counters the daemon's ``/metrics``
+    reports, the misses per shape bucket.  Real backend compiles are
+    accounted separately (``jax_compile_s/_n``, obs.tracing's monitoring
+    listener) — compare the two to see warm-path effectiveness."""
+    from iterative_cleaner_tpu.obs import tracing
+
+    key = tuple(key)
+    if key in _seen:
+        tracing.count("compile_cache_key_hits")
+        return False
+    tracing.count("compile_cache_key_misses")
+    tracing.count_labeled("compile_keys_total",
+                          {"shape_bucket": _shape_bucket_of(key)})
+    _seen.add(key)
     if len(_seen) >= DISTINCT_SHAPE_LIMIT:
         import jax
 
         jax.clear_caches()
         _seen.clear()
+        tracing.count("compile_cache_drops")
         return True
     return False

@@ -1,116 +1,25 @@
-"""Profiling / tracing hooks (SURVEY.md §5: the reference has none; the TPU
-framework exposes jax.profiler traces plus per-iteration host timings) —
-plus the process-global phase counters the serving daemon's ``/metrics``
-endpoint reports (service/api.py)."""
+"""Compatibility shim: the telemetry layer grew into
+:mod:`iterative_cleaner_tpu.obs` (trace context, histograms, Prometheus
+exposition, convergence forensics — see docs/OBSERVABILITY.md).  This
+module re-exports the same process-global registry, so every existing
+``from iterative_cleaner_tpu.utils import tracing`` call site keeps
+accounting into the one place the daemon's ``/metrics`` reports."""
 
-from __future__ import annotations
-
-import contextlib
-import threading
-import time
-
-
-@contextlib.contextmanager
-def profile_trace(trace_dir: str | None):
-    """jax.profiler trace around a block when trace_dir is set (view with
-    tensorboard or xprof); no-op otherwise."""
-    if not trace_dir:
-        yield
-        return
-    import jax
-
-    with jax.profiler.trace(trace_dir):
-        yield
-
-
-# --- per-phase counters (the serving daemon's /metrics source) ---
-#
-# A deliberately tiny metrics registry: monotonic floats keyed by name,
-# process-global so every layer (driver, batch dispatch, service worker)
-# can account into one place without plumbing a registry object through
-# call signatures.  ``observe_phase`` follows the Prometheus summary
-# convention (``<name>_s`` total seconds + ``<name>_n`` count), which is
-# what the per-stage accounting of astronomical pipelines needs
-# ("Pipeline Collector", arXiv:1807.05733): mean stage latency is
-# ``load_s / load_n`` with no histogram machinery.
-
-_counters: dict[str, float] = {}
-_counters_lock = threading.Lock()
-
-
-def count(name: str, inc: float = 1.0) -> None:
-    """Add ``inc`` to the process-global counter ``name``."""
-    with _counters_lock:
-        _counters[name] = _counters.get(name, 0.0) + inc
-
-
-def observe_phase(name: str, seconds: float) -> None:
-    """Record one completed phase: total seconds + occurrence count + the
-    worst single occurrence (``<name>_max_s``) — the summary pair gives the
-    mean, but a latency contract (the online path's per-block alert bound)
-    is about the tail, and max is the cheapest tail statistic that needs no
-    histogram state."""
-    with _counters_lock:
-        _counters[f"{name}_s"] = _counters.get(f"{name}_s", 0.0) + seconds
-        _counters[f"{name}_n"] = _counters.get(f"{name}_n", 0.0) + 1.0
-        key = f"{name}_max_s"
-        if seconds > _counters.get(key, 0.0):
-            _counters[key] = seconds
-
-
-@contextlib.contextmanager
-def phase(name: str):
-    """Time a block into :func:`observe_phase` (exceptions still count —
-    a failing load is still a load the operator wants in the latency
-    accounting)."""
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        observe_phase(name, time.perf_counter() - t0)
-
-
-def counters_snapshot() -> dict[str, float]:
-    """Point-in-time copy of every counter, sorted by name (stable JSON)."""
-    with _counters_lock:
-        return dict(sorted(_counters.items()))
-
-
-def snapshot(prefix: str = "") -> dict[str, float]:
-    """:func:`counters_snapshot`, optionally filtered to one subsystem's
-    ``prefix`` — the before/after idiom tests use so counter state from one
-    case never bleeds into another's assertions (delta = snapshot() minus an
-    earlier snapshot(), no global reset needed mid-process)."""
-    snap = counters_snapshot()
-    if not prefix:
-        return snap
-    return {k: v for k, v in snap.items() if k.startswith(prefix)}
-
-
-def delta(before: dict[str, float], key: str) -> float:
-    """Counter movement since a :func:`snapshot`; missing keys read 0."""
-    return counters_snapshot().get(key, 0.0) - before.get(key, 0.0)
-
-
-def reset_counters() -> None:
-    """Zero the registry (tests only — production counters are cumulative
-    for the life of the process, like any scrape target)."""
-    with _counters_lock:
-        _counters.clear()
-
-
-class StepTimer:
-    """Wall-clock per iteration, reported through the progress callback.
-    perf_counter: monotonic (no negative laps on wall-clock steps) and
-    high-resolution (no 0.0 laps on coarse system clocks)."""
-
-    def __init__(self) -> None:
-        self._t0 = time.perf_counter()
-        self.durations: list[float] = []
-
-    def lap(self) -> float:
-        now = time.perf_counter()
-        dt = now - self._t0
-        self._t0 = now
-        self.durations.append(dt)
-        return dt
+from iterative_cleaner_tpu.obs.tracing import (  # noqa: F401
+    HIST_BOUNDS,
+    StepTimer,
+    compile_scope,
+    count,
+    count_labeled,
+    counters_snapshot,
+    delta,
+    histograms_snapshot,
+    install_compile_listener,
+    labeled_snapshot,
+    observe_phase,
+    phase,
+    profile_trace,
+    reset_counters,
+    shape_bucket_label,
+    snapshot,
+)

@@ -54,6 +54,16 @@ def test_success_path_emits_driver_contract():
     assert payload["parity_small_config"] is True
     assert payload["config_a"]["parity_full_loop"] is True
     assert "error" not in payload
+    # compile/cache accounting rides the payload (obs layer).  The key-
+    # level counters are this repo's own code and must be live; the
+    # backend-compile listener is best-effort over jax's private monitoring
+    # surface (install_compile_listener degrades silently on API drift), so
+    # only its keys' presence is asserted, not a positive count.
+    acct = payload["compile_accounting"]
+    assert acct["compile_cache_key_misses"] > 0
+    for key in ("backend_compiles_n", "backend_compile_s",
+                "compile_cache_key_hits", "persistent_cache_hits"):
+        assert key in acct, key
 
 
 @pytest.mark.slow

@@ -197,3 +197,305 @@ def test_x64_without_enable_raises():
     w0 = np.ones((2, 2), np.float32)
     with pytest.raises(RuntimeError, match="JAX_ENABLE_X64"):
         clean_cube(D, w0, CleanConfig(backend="jax", x64=True))
+
+
+# --- PR 3 (ict-obs): structured telemetry — trace context, Prometheus
+# exposition with histograms, convergence forensics ---
+
+import json as _json
+import re
+import urllib.error
+import urllib.request
+
+from iterative_cleaner_tpu import __version__
+from iterative_cleaner_tpu.obs import events, forensics, metrics
+from iterative_cleaner_tpu.utils import tracing
+
+#: Strict Prometheus text-format line grammar: comment lines (HELP/TYPE)
+#: or samples `name{label="v",...} value`.
+_PROM_LINE = re.compile(
+    r"^(?:# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(?:\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""
+    r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})?"
+    r" -?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|\+Inf|NaN))$")
+
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (.+)$")
+
+
+def _parse_prometheus(text: str):
+    """Strict per-line validation; returns [(name, labels_str, value)]."""
+    samples = []
+    for line in text.splitlines():
+        assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE.match(line)
+        samples.append((m.group(1), m.group(2) or "", m.group(3)))
+    return samples
+
+
+def test_phase_exception_bumps_err_counter():
+    before = tracing.snapshot("t_obs_boom")
+    with pytest.raises(RuntimeError):
+        with tracing.phase("t_obs_boom"):
+            raise RuntimeError("synthetic")
+    assert tracing.delta(before, "t_obs_boom_n") == 1     # still counted
+    assert tracing.delta(before, "t_obs_boom_err_n") == 1  # and visible
+    with tracing.phase("t_obs_boom"):
+        pass
+    assert tracing.delta(before, "t_obs_boom_err_n") == 1  # successes don't
+
+
+def test_prometheus_exposition_grammar_and_invariants():
+    """The satellite contract: strict line grammar, cumulative-histogram
+    monotonicity, and every `_s` total carrying a matching `_n` count."""
+    tracing.observe_phase("t_obs_expo", 0.003)
+    tracing.observe_phase("t_obs_expo", 0.2)
+    tracing.count_labeled("t_obs_total", {"route": "unit"}, 2)
+    samples = _parse_prometheus(metrics.render_prometheus())
+    names = {n for n, _, _ in samples}
+    # histogram monotonicity, per phase, in exposition order
+    by_phase: dict[str, list[float]] = {}
+    for n, labels, v in samples:
+        if n == "ict_phase_duration_seconds_bucket":
+            phase = re.search(r'phase="([^"]*)"', labels).group(1)
+            by_phase.setdefault(phase, []).append(float(v))
+    assert "t_obs_expo" in by_phase
+    for phase, buckets in by_phase.items():
+        assert buckets == sorted(buckets), f"non-monotonic buckets: {phase}"
+    flat = {n: v for n, labels, v in samples if not labels}
+    assert float(by_phase["t_obs_expo"][-1]) >= 2  # +Inf holds every obs
+    # every `_s` total has a matching `_n` count
+    for n in names:
+        if n.endswith("_s") and not n.endswith("_max_s") and n in flat:
+            assert n[:-2] + "_n" in names, f"{n} has no matching _n"
+    # labeled counters render with their labels
+    assert any(n == "ict_t_obs_total" and 'route="unit"' in labels
+               for n, labels, _ in samples)
+
+
+def test_events_span_nesting_and_sink(tmp_path):
+    sink = str(tmp_path / "ev.jsonl")
+    events.configure(sink)
+    try:
+        assert events.enabled()
+        with events.trace_scope("feedcafefeedcafe"):
+            with events.span("outer", kind="unit"):
+                events.emit("inner_point", detail=1)
+        events.emit("outside")
+    finally:
+        events.configure(None)
+    assert not events.enabled()
+    recs = [_json.loads(line) for line in open(sink)]
+    kinds = [r["event"] for r in recs]
+    assert kinds == ["outer_start", "inner_point", "outer_end", "outside"]
+    assert all(r["trace_id"] == "feedcafefeedcafe" for r in recs[:3])
+    start, point, end = recs[:3]
+    assert point["span_id"] == start["span_id"]  # nested emit inherits
+    assert end["status"] == "ok" and end["duration_s"] >= 0
+    assert {"ts", "event", "trace_id", "span_id"} <= set(recs[0])
+
+
+def test_masks_bit_identical_with_telemetry_and_forensics(
+        tmp_path, monkeypatch, small_archive):
+    """The read-only guarantee: telemetry + deep forensics enabled, every
+    execution mode still produces the oracle's exact mask (and now agrees
+    on the termination reason too)."""
+    from iterative_cleaner_tpu.parallel.mesh import make_mesh
+    from iterative_cleaner_tpu.parallel.sharded import sharded_clean_single
+    import jax
+
+    monkeypatch.setenv("ICT_FORENSICS", "1")
+    events.configure(str(tmp_path / "parity.jsonl"))
+    try:
+        D, w0 = preprocess(small_archive)
+        res_np = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=4))
+        assert res_np.termination in ("fixed_point", "cycle", "max_iter")
+        modes = {
+            "stepwise": CleanConfig(backend="jax", max_iter=4),
+            "fused": CleanConfig(backend="jax", max_iter=4, fused=True),
+            "chunked": CleanConfig(backend="jax", max_iter=4, chunk_block=3),
+        }
+        for name, cfg in modes.items():
+            res = clean_cube(D, w0, cfg)
+            np.testing.assert_array_equal(
+                res.weights, res_np.weights, err_msg=name)
+            assert res.loops == res_np.loops, name
+            assert res.termination == res_np.termination, name
+            # deep forensics filled per-diagnostic votes on every iteration
+            assert all(i.zaps_by_diagnostic is not None
+                       for i in res.iterations), name
+        mesh = make_mesh(8, devices=jax.devices("cpu"))
+        _t, w_sh, loops_sh, _done = sharded_clean_single(
+            D, w0, CleanConfig(backend="jax", max_iter=4), mesh)
+        np.testing.assert_array_equal(w_sh, res_np.weights)
+        assert loops_sh == res_np.loops
+    finally:
+        events.configure(None)
+
+
+def test_attribute_zaps_votes(small_archive):
+    """Every zap carries >= 2 diagnostic votes: the combined score is the
+    median of the four scaled diagnostics, so score >= 1 forces the two
+    upper order statistics >= 1.  Pinned on iteration 1 AND on a later
+    iteration (w_prev != w0 — the template weighting the attribution must
+    replay), at thresholds where iteration 2 genuinely changes the mask."""
+    from iterative_cleaner_tpu.backends.numpy_backend import NumpyCleaner
+
+    D, w0 = preprocess(small_archive)
+    cfg = CleanConfig(backend="numpy", chanthresh=3, subintthresh=3,
+                      max_iter=5)
+    backend = NumpyCleaner(D, w0, cfg)
+    w_prev = w0
+    for iteration in (1, 2):
+        _test, new_w = backend.step(w_prev)
+        votes = forensics.attribute_zaps(D, w0, w_prev, new_w, cfg)
+        assert set(votes) == set(forensics.DIAGNOSTIC_NAMES)
+        n_zapped = int(((new_w == 0) & (w0 != 0)).sum())
+        assert n_zapped > 0, iteration
+        assert all(0 <= v <= n_zapped for v in votes.values()), iteration
+        assert sum(votes.values()) >= 2 * n_zapped, iteration
+        assert not np.array_equal(new_w, w_prev)  # both iterations moved
+        w_prev = new_w
+
+
+def test_iteration_info_churn_split(small_archive):
+    D, w0 = preprocess(small_archive)
+    res = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=4))
+    for info in res.iterations:
+        assert info.diff_weights == info.n_new_zaps + info.n_unzapped
+
+
+def _start_service(tmp_path, **kw):
+    import jax
+
+    from iterative_cleaner_tpu.parallel.mesh import make_mesh
+    from iterative_cleaner_tpu.service import CleaningService, ServeConfig
+
+    mesh = make_mesh(8, devices=jax.devices("cpu"))
+    defaults = dict(spool_dir=str(tmp_path / "spool"), port=0,
+                    deadline_s=0.2, quiet=True,
+                    clean=CleanConfig(backend="jax", max_iter=3, quiet=True,
+                                      no_log=True))
+    defaults.update(kw)
+    svc = CleaningService(ServeConfig(**defaults), mesh=mesh)
+    svc.start()
+    return svc
+
+
+def _http_json(svc, route):
+    return _json.load(urllib.request.urlopen(
+        f"http://127.0.0.1:{svc.port}{route}", timeout=30))
+
+
+def test_daemon_trace_context_end_to_end(tmp_path):
+    """The acceptance path: a trace_id returned by POST /jobs appears in
+    the worker's event log (admission, dispatch, per-iteration events) and
+    in GET /jobs/<id>/trace with the full iteration timeline; /metrics is
+    genuine Prometheus text; /healthz carries the drain signals."""
+    sink = str(tmp_path / "events.jsonl")
+    archive_path = str(tmp_path / "t.npz")
+    NpzIO().save(make_archive(nsub=8, nchan=16, nbin=64, seed=5),
+                 archive_path)
+    svc = _start_service(tmp_path, telemetry=sink)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc.port}/jobs",
+            data=_json.dumps({"path": archive_path}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(req, timeout=30)
+        job = _json.load(resp)
+        trace_id = job["trace_id"]
+        assert trace_id and resp.headers["X-ICT-Trace"] == trace_id
+        assert svc.drain(120)
+
+        # per-job forensics timeline
+        tr = _http_json(svc, f"/jobs/{job['id']}/trace")
+        assert tr["trace_id"] == trace_id
+        assert tr["termination"] in ("fixed_point", "cycle", "max_iter")
+        assert [e["index"] for e in tr["timeline"]] == list(
+            range(1, len(tr["timeline"]) + 1))
+        assert tr["timeline"], "timeline must be recorded with telemetry on"
+        # the oracle agrees with what the daemon served
+        res_np = clean_cube(*preprocess(NpzIO().load(archive_path)),
+                            CleanConfig(backend="numpy", max_iter=3))
+        assert tr["loops"] == res_np.loops
+        assert tr["termination"] == res_np.termination
+
+        # Prometheus exposition over real HTTP
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}/metrics", timeout=30)
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        samples = _parse_prometheus(resp.read().decode())
+        names = {n for n, _, _ in samples}
+        assert "ict_service_jobs_submitted" in names
+        assert "ict_phase_duration_seconds_bucket" in names
+        # legacy JSON preserved
+        legacy = _http_json(svc, "/metrics.json")
+        assert legacy["service_jobs_submitted"] >= 1
+
+        health = _http_json(svc, "/healthz")
+        assert health["version"] == __version__
+        assert health["uptime_s"] > 0
+        for key in ("load_queue_depth", "dispatch_queue_depth",
+                    "bucketed_cubes", "open_sessions"):
+            assert key in health
+
+        # unknown sub-route under a job 404s
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{svc.port}/jobs/{job['id']}/nope",
+                timeout=30)
+        assert exc_info.value.code == 404
+    finally:
+        svc.stop()
+        events.configure(None)
+
+    recs = [_json.loads(line) for line in open(sink)]
+    by_event = {}
+    for r in recs:
+        by_event.setdefault(r["event"], []).append(r)
+    for needed in ("job_submitted", "admission", "dispatch", "iteration",
+                   "job_done"):
+        assert any(r["trace_id"] == trace_id for r in by_event[needed]), (
+            needed, by_event.keys())
+    # exactly the job's own iterations under its trace (the in-test oracle
+    # run above also emitted iteration events, under no trace)
+    assert len([r for r in by_event["iteration"]
+                if r["trace_id"] == trace_id]) == len(tr["timeline"])
+
+
+def test_daemon_session_trace_id_and_block_events(tmp_path):
+    """Streaming sessions are an entry point too: the manifest carries the
+    minted trace_id and every ingested block lands in the event log under
+    it."""
+    from iterative_cleaner_tpu.online.blocks import encode_block
+    from iterative_cleaner_tpu.online.state import SessionMeta
+
+    sink = str(tmp_path / "sess_events.jsonl")
+    archive = make_archive(nsub=4, nchan=16, nbin=64, seed=9)
+    svc = _start_service(tmp_path, telemetry=sink)
+    try:
+        meta = SessionMeta.from_archive(archive).to_dict()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc.port}/sessions",
+            data=_json.dumps(meta).encode(),
+            headers={"Content-Type": "application/json"})
+        sess = _json.load(urllib.request.urlopen(req, timeout=30))
+        trace_id = sess["trace_id"]
+        assert trace_id
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc.port}/sessions/{sess['id']}/blocks",
+            data=encode_block(archive.data, archive.weights),
+            headers={"Content-Type": "application/octet-stream"})
+        urllib.request.urlopen(req, timeout=30)
+    finally:
+        svc.stop()
+        events.configure(None)
+    recs = [_json.loads(line) for line in open(sink)]
+    blocks = [r for r in recs if r["event"] == "online_block"]
+    assert blocks and all(r["trace_id"] == trace_id for r in blocks)
+    assert any(r["event"] == "session_opened" and r["trace_id"] == trace_id
+               for r in recs)

@@ -379,7 +379,7 @@ def test_daemon_session_end_to_end(tmp_path):
         assert _post(svc, f"/sessions/{sess2['id']}/finish", b"",
                      expect_error=True) == 400   # no blocks to finalize
 
-        metrics = _get(svc, "/metrics")
+        metrics = _get(svc, "/metrics.json")
         # online_block_n also counts the REFUSED ingests above (the
         # tracing.phase exceptions-count rule); the success counter is
         # exact and the latency summary/max are what /metrics promises.

@@ -255,7 +255,7 @@ def test_daemon_end_to_end_mixed_shapes(tmp_path):
         health = _get(svc, "/healthz")
         assert health["status"] == "ok" and health["backend"] == "jax"
         assert health["open_jobs"] == 0
-        metrics = _get(svc, "/metrics")
+        metrics = _get(svc, "/metrics.json")
         d = lambda k: metrics.get(k, 0) - before.get(k, 0)
         assert d("service_jobs_submitted") == 4
         assert d("service_jobs_done") == 3 and d("service_jobs_error") == 1
