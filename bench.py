@@ -141,6 +141,16 @@ def _headline(payload: dict) -> dict:
         })
     except Exception:  # noqa: BLE001 — the JSON line is the contract
         pass
+    try:
+        from iterative_cleaner_tpu.obs import memory as _obs_memory
+
+        # Host RSS + per-device HBM view + every recorded executable
+        # analysis.  Safe on EVERY exit path: obs/memory reads devices
+        # only when a backend is already live, so the watchdog/error
+        # paths (where first init may have hung) cannot hang again here.
+        payload.setdefault("memory", _obs_memory.memory_report())
+    except Exception:  # noqa: BLE001 — the JSON line is the contract
+        pass
     value = payload.get("end_to_end_speedup", 0.0)
     shape = payload.get("config_a", {}).get("shape", [NSUB, NCHAN, NBIN])
     out = {
@@ -200,17 +210,25 @@ def _init_device(retries: int = 3, sleep_s: float = 20.0):
         # "error" falls through: fast failures are what the bounded
         # in-process retry below exists for.
 
+    from iterative_cleaner_tpu.utils.device_probe import init_watchdog
+
     last = None
-    for attempt in range(retries):
-        try:
-            dev = jax.devices()[0]
-            log(f"device: {dev.platform} ({dev.device_kind})"
-                + (f" [attempt {attempt + 1}]" if attempt else ""))
-            return dev
-        except Exception as exc:  # noqa: BLE001 — retried, then reported
-            last = exc
-            log(f"device init attempt {attempt + 1}/{retries} failed: {exc}")
-            time.sleep(sleep_s)
+    # The watchdog (ICT_INIT_TIMEOUT_S) is the belt to the probe's
+    # suspenders: if the tunnel wedges AFTER a probe passed, the hang at
+    # jax.devices() below at least logs a structured warning before the
+    # bench watchdog's payload-and-exit fires.
+    with init_watchdog("bench device init"):
+        for attempt in range(retries):
+            try:
+                dev = jax.devices()[0]
+                log(f"device: {dev.platform} ({dev.device_kind})"
+                    + (f" [attempt {attempt + 1}]" if attempt else ""))
+                return dev
+            except Exception as exc:  # noqa: BLE001 — retried, then reported
+                last = exc
+                log(f"device init attempt {attempt + 1}/{retries} failed: "
+                    f"{exc}")
+                time.sleep(sleep_s)
     raise RuntimeError(f"device init failed after {retries} attempts: {last}")
 
 
@@ -558,13 +576,28 @@ def _bench_static_analysis() -> dict:
             ca = ca[0]
         return round(float(ca["bytes accessed"]) / cube, 2)
 
-    dense = cost_cubes(clean_step.lower(
-        D, w, v, w, s, s, pulse_region=pr, use_pallas=False).compile())
-    incr = cost_cubes(step_from_template.lower(
-        D, w, v, t, s, s, pulse_region=pr, use_pallas=False).compile())
+    dense_c = clean_step.lower(
+        D, w, v, w, s, s, pulse_region=pr, use_pallas=False).compile()
+    dense = cost_cubes(dense_c)
+    incr_c = step_from_template.lower(
+        D, w, v, t, s, s, pulse_region=pr, use_pallas=False).compile()
+    incr = cost_cubes(incr_c)
     fused = fused_clean.lower(
         D, w, v, s, s, max_iter=MAX_ITER, pulse_region=pr,
         want_residual=False, use_pallas=False, incremental=True).compile()
+    # Register the analyses in the obs/memory executable registry so the
+    # payload's top-level "memory" block (emitted on every exit path)
+    # carries them under their shape-bucket labels.
+    try:
+        from iterative_cleaner_tpu.obs import memory as obs_memory
+        from iterative_cleaner_tpu.obs.tracing import shape_bucket_label
+
+        bucket = shape_bucket_label(shape)
+        obs_memory.note_executable(f"{bucket}:step_dense", dense_c)
+        obs_memory.note_executable(f"{bucket}:step_incremental", incr_c)
+        obs_memory.note_executable(f"{bucket}:fused", fused)
+    except Exception:  # noqa: BLE001 — the section's own keys still land
+        pass
     res = {
         "backend": jax.default_backend(),
         "shape": list(shape),

@@ -243,14 +243,29 @@ def main(argv: list[str] | None = None) -> int:
         # (ICT_NO_COMPILE_CACHE=1 opts out).  The trim keeps the on-disk
         # cache size-bounded (ICT_COMPILE_CACHE_MAX_MB; ADVICE r05).
         enable_and_trim_persistent_cache()
-        if events.enabled():
+        if events.active():
+            # With the always-on flight recorder (obs/flight), compile
+            # accounting is worth its one-time listener registration even
+            # without a telemetry sink: real-compile phases then show up
+            # in post-mortem rings too.
             from iterative_cleaner_tpu.obs import tracing
 
             tracing.install_compile_listener()
+    # The first in-process jax.devices() of the run happens inside the
+    # driver; the watchdog (utils/device_probe) turns a wedged-tunnel
+    # first-init freeze into a structured warning after ICT_INIT_TIMEOUT_S
+    # (it checks backend LIVENESS at the deadline, so a long clean on a
+    # live backend stays silent).  No-op on the numpy backend.
+    import contextlib
+
+    from iterative_cleaner_tpu.utils.device_probe import init_watchdog
+
+    watchdog = (init_watchdog("cli backend init")
+                if cfg.backend == "jax" else contextlib.nullcontext())
     # The CLI is an entry point: mint the run's trace context and bind it
     # so every nested telemetry event (route decisions, per-iteration
     # forensics, per-archive spans) carries this invocation's trace_id.
-    with events.trace_scope(events.new_trace_id()), \
+    with watchdog, events.trace_scope(events.new_trace_id()), \
             events.span("cli_run", argv=list(argv)):
         if sweep_pairs is not None:
             from iterative_cleaner_tpu.driver import run_sweep

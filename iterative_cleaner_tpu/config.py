@@ -91,7 +91,10 @@ class CleanConfig:
     stream: bool = False           # sharded_batch: dispatch buckets as loads complete
     resume: bool = False           # skip archives whose cleaned output exists
     dump_masks: bool = False       # save mask history NPZ next to the output
-    trace_dir: str = ""            # jax.profiler trace output directory
+    trace_dir: str = ""            # jax.profiler trace output directory (the
+                                   # one-shot CLI capture; the serving
+                                   # daemon's bounded on-demand captures
+                                   # live in obs/profiling.py)
 
     def __post_init__(self) -> None:
         if self.max_iter < 1:

@@ -122,7 +122,7 @@ class LoopState:
         self.infos.append(info)
         if progress is not None:
             progress(info)
-        if events.enabled():
+        if events.active():
             events.emit("iteration", **forensics.iteration_record(info))
 
         # Full-history cycle detection, pre-loop weights included (§8.L10);
@@ -282,9 +282,12 @@ def clean_cube(
         with _cscope(_sbl(D.shape)):
             sharded = maybe_clean_sharded(D, w0, cfg, want_residual)
         if sharded is not None:
-            if _events.enabled():
+            if _events.active():
                 _events.emit("clean_route", route="sharded",
                              shape=list(D.shape))
+            from iterative_cleaner_tpu.obs import memory as _obs_memory
+
+            _obs_memory.observe_route("sharded")
             # No x64/want_residual axes (maybe_clean_sharded declines both);
             # max_iter/pulse_region are statics of the sharded kernel.
             note_compiled_shape(
@@ -375,10 +378,13 @@ def clean_cube(
     if cfg.fused and chunk_block is None:
         from iterative_cleaner_tpu.backends.jax_backend import run_fused
 
-        if events.enabled():
+        if events.active():
             events.emit("clean_route", route="fused", shape=list(D.shape))
         with compile_scope(shape_bucket_label(D.shape)):
             out = run_fused(D, w0, cfg, want_residual=want_residual)
+        from iterative_cleaner_tpu.obs import memory as obs_memory
+
+        obs_memory.observe_route("fused")
         test, w_final, loops, done, _x, history = out[:6]
         history = list(history)
         infos = []
@@ -390,7 +396,7 @@ def clean_cube(
             infos.append(info)
             if progress is not None:
                 progress(info)
-            if events.enabled():
+            if events.active():
                 events.emit("iteration", **forensics.iteration_record(info))
         return CleanResult(
             weights=w_final,
@@ -406,13 +412,13 @@ def clean_cube(
     if chunk_block is not None:
         from iterative_cleaner_tpu.parallel.chunked import ChunkedJaxCleaner
 
-        if events.enabled():
+        if events.active():
             events.emit("clean_route", route="chunked", shape=list(D.shape),
                         block=chunk_block, why=chunk_why)
         backend = ChunkedJaxCleaner(
             D, w0, cfg, block=chunk_block, keep_residual=want_residual)
     else:
-        if events.enabled():
+        if events.active():
             events.emit("clean_route",
                         route="stepwise" if cfg.backend == "jax" else "numpy",
                         shape=list(D.shape))
@@ -420,6 +426,11 @@ def clean_cube(
     state = LoopState.start(w0)
     with compile_scope(shape_bucket_label(D.shape)):
         state.run(backend, cfg.max_iter, progress=progress)
+    if cfg.backend == "jax":
+        from iterative_cleaner_tpu.obs import memory as obs_memory
+
+        obs_memory.observe_route("chunked" if chunk_block is not None
+                                 else "stepwise")
 
     residual = None
     if want_residual:

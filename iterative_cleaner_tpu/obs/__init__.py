@@ -1,25 +1,45 @@
 """obs — the structured-telemetry layer (docs/OBSERVABILITY.md).
 
 What grew out of ``utils/tracing.py`` (which remains as a compatibility
-shim over :mod:`.tracing`), organised as three pillars:
+shim over :mod:`.tracing`), organised as pillars:
 
 - :mod:`.events`    — trace context (``trace_id``/``span_id``) minted at
                       every entry point and a JSON-lines event log
                       (``--telemetry out.jsonl`` / ``ICT_TELEMETRY``);
-- :mod:`.tracing`   — the process-global counter registry, now with fixed
-                      log2-bucket latency histograms, error counters and
-                      labeled counters, plus the jax compile listener;
+- :mod:`.tracing`   — the process-global counter registry, with fixed
+                      log2-bucket latency histograms, error counters,
+                      labeled counters and gauges, plus the jax compile
+                      listener;
 - :mod:`.metrics`   — Prometheus text exposition over the registry (the
                       daemon's ``/metrics``; legacy JSON at
                       ``/metrics.json``);
 - :mod:`.forensics` — convergence forensics: per-diagnostic zap
-                      attribution and termination reasons.
+                      attribution and termination reasons;
+- :mod:`.flight`    — the always-on bounded flight-recorder ring of
+                      recent events/phase timings, dumped on fault-ladder
+                      trips / SIGTERM and served at ``GET /debug/flight``;
+- :mod:`.profiling` — on-demand bounded ``jax.profiler`` captures
+                      (``POST /debug/profile``, per-job capture) grown
+                      from the ``trace_dir`` one-shot;
+- :mod:`.memory`    — HBM / host-RSS / compiled-executable memory+cost
+                      accounting: every ``memory_stats()`` read in the
+                      tree, exported as gauges and JSON reports.
 
 Everything here is strictly read-only on the math: no hook ever touches a
 mask, and every hook is a no-op when its sink is disabled, so the hot path
-pays nothing (the fuzz corpus pins mask bit-identity with telemetry on).
+pays nothing (the fuzz corpus pins mask bit-identity with telemetry, the
+flight recorder, and profiler capture on).
 """
 
-from iterative_cleaner_tpu.obs import events, forensics, metrics, tracing
+from iterative_cleaner_tpu.obs import (
+    events,
+    flight,
+    forensics,
+    memory,
+    metrics,
+    profiling,
+    tracing,
+)
 
-__all__ = ["events", "forensics", "metrics", "tracing"]
+__all__ = ["events", "flight", "forensics", "memory", "metrics",
+           "profiling", "tracing"]

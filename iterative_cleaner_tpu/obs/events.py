@@ -33,6 +33,8 @@ import time
 import uuid
 from dataclasses import dataclass
 
+from iterative_cleaner_tpu.obs import flight
+
 
 @dataclass(frozen=True)
 class TraceContext:
@@ -95,6 +97,16 @@ def enabled() -> bool:
     return _explicit is not None
 
 
+def active() -> bool:
+    """Whether ANY consumer of :func:`emit` exists: the JSON-lines sink OR
+    the always-on flight recorder (:mod:`.flight`, which mirrors every
+    event into its bounded ring).  Call-site guards that only exist to
+    skip building kwargs should use this, not :func:`enabled` — with the
+    flight recorder on by default, an event skipped "because no sink" is
+    an event missing from the post-mortem."""
+    return enabled() or flight.enabled()
+
+
 def current() -> TraceContext | None:
     return _current.get()
 
@@ -123,16 +135,20 @@ def emit(event: str, trace_id: str | None = None, span_id: str | None = None,
     ``SINK_RETRY_S`` with one stderr warning, then tries again, rather
     than failing the clean it was observing or going silent forever."""
     global _fh, _fh_path, _warned, _retry_at
+    ctx = _current.get()
+    tid = trace_id if trace_id is not None else (ctx.trace_id if ctx else "")
+    sid = span_id if span_id is not None else (ctx.span_id if ctx else "")
+    # Mirror every event into the always-on flight ring FIRST (bounded,
+    # no I/O, independent of the sink): the recorder's whole point is the
+    # incident nobody configured telemetry for.
+    flight.note(event, trace_id=tid, **fields)
     if not enabled():
         return
-    ctx = _current.get()
     rec = {
         "ts": round(time.time(), 6),
         "event": event,
-        "trace_id": trace_id if trace_id is not None
-        else (ctx.trace_id if ctx else ""),
-        "span_id": span_id if span_id is not None
-        else (ctx.span_id if ctx else ""),
+        "trace_id": tid,
+        "span_id": sid,
     }
     rec.update(fields)
     line = json.dumps(rec, default=str) + "\n"
@@ -174,8 +190,8 @@ def span(name: str, trace_id: str | None = None, **fields):
     this span's id as their ``span_id``, and nested *spans* record it as
     their ``parent_span_id`` (the span's own start/end events carry both).
     The end event records ``duration_s`` and ``status`` ("ok"/"error").
-    Fast no-op when the sink is disabled."""
-    if not enabled():
+    Fast no-op when neither the sink nor the flight recorder is active."""
+    if not active():
         yield
         return
     ctx = _current.get()

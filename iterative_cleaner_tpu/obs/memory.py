@@ -1,0 +1,300 @@
+"""Memory + compiled-executable cost accounting.
+
+One module owns every ``device.memory_stats()`` read in the tree (the
+ad-hoc probe that lived in ``parallel/autoshard.py`` delegates here, so
+the autoshard routing decision and the exported gauges can never disagree
+about what a device reported), plus host RSS and XLA's own static
+accounting (``cost_analysis()`` / ``memory_analysis()``) of the compiled
+executables, keyed by the same shape-bucket labels
+``note_compiled_shape`` tracks.
+
+Everything lands in :mod:`.tracing` gauges — rendered on the daemon's
+``/metrics`` (``ict_hbm_bytes_in_use{device=...}``,
+``ict_route_hbm_peak_bytes{route=...}``, ``ict_host_rss_bytes``,
+``ict_executable_*{shape_bucket=...}``) — and in the JSON
+:func:`memory_report` that bench.py attaches to its one-line payload on
+every exit path and the daemon attaches to job manifests.
+
+Strictly read-only on the math, and strictly *optional* on the platform:
+CPU backends report no memory stats, a numpy-mode daemon never imports
+jax, and nothing here may trigger a backend init (a wedged tunnel would
+turn a metrics scrape into a process-wide hang — the CLAUDE.md quirk), so
+every device read first checks that a backend is already live.
+"""
+
+from __future__ import annotations
+
+import os
+
+from iterative_cleaner_tpu.obs import tracing
+
+_ENV_OVERRIDE = "ICT_HBM_BYTES"
+
+#: Devices whose memory_stats() raised once (backends without
+#: introspection raise the same way forever — don't pay the exception per
+#: scrape).
+_stats_unsupported: set = set()
+
+#: shape_bucket -> executable analysis dict (analyze once per bucket; the
+#: AOT compile behind it is the expensive part and the answer is static).
+_exec_registry: dict[str, dict] = {}
+
+
+def hbm_override_bytes() -> int | None:
+    """The ``ICT_HBM_BYTES`` escape hatch (tests, and hosts where the
+    runtime misreports) — honored before any device is touched."""
+    env = os.environ.get(_ENV_OVERRIDE)
+    if env:
+        return int(env)
+    return None
+
+
+def backend_live() -> bool:
+    """Whether a JAX backend is already initialized in this process.  The
+    gate every device read here sits behind: observability must never be
+    the thing that triggers (and possibly hangs) first backend init."""
+    from iterative_cleaner_tpu.utils.device_probe import _backend_liveness
+
+    return _backend_liveness() == "live"
+
+
+def device_stats(device) -> dict | None:
+    """One device's ``memory_stats()``, or None when the backend has no
+    introspection (remembered per device kind so the exception is paid
+    once, not per scrape)."""
+    key = (getattr(device, "platform", ""), getattr(device, "id", -1))
+    if key in _stats_unsupported:
+        return None
+    try:
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001 — backend without memory introspection
+        _stats_unsupported.add(key)
+        return None
+    return stats if stats else None
+
+
+def device_memory_bytes(device=None, default_device_fn=None) -> int | None:
+    """Best-effort per-device memory capacity (autoshard's routing input).
+
+    Resolution order: the ``ICT_HBM_BYTES`` env override, the device's
+    ``memory_stats()['bytes_limit']`` (TPU), else None (unknown — e.g. CPU
+    backends report no limit).  ``default_device_fn`` supplies the device
+    lazily so the env-override path never touches a backend."""
+    env = hbm_override_bytes()
+    if env is not None:
+        return env
+    if device is None:
+        if default_device_fn is not None:
+            device = default_device_fn()
+        else:
+            if not backend_live():
+                return None
+            import jax
+
+            device = jax.devices()[0]
+    stats = device_stats(device)
+    if stats is None:
+        return None
+    limit = stats.get("bytes_limit")
+    return int(limit) if limit else None
+
+
+def host_rss_bytes() -> int:
+    """This process's resident set, from /proc (Linux) with a
+    getrusage fallback; 0 when neither works."""
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+
+        # ru_maxrss is kilobytes on Linux (peak, not current — the honest
+        # fallback is still better than 0).
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:  # noqa: BLE001 — accounting is best-effort
+        return 0
+
+
+def device_snapshot() -> list[dict]:
+    """Per-local-device memory view (empty when no backend is live or the
+    platform has no introspection)."""
+    if not backend_live():
+        return []
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — introspection is best-effort
+        return []
+    out = []
+    for dev in devices:
+        stats = device_stats(dev)
+        if stats is None:
+            continue
+        out.append({
+            "device": f"{dev.platform}:{dev.id}",
+            "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
+            "bytes_limit": int(stats.get("bytes_limit", 0)),
+        })
+    return out
+
+
+def update_process_gauges() -> None:
+    """Refresh the current/peak HBM gauges per device and the host RSS
+    gauge — the daemon's tick loop calls this every couple of seconds so a
+    scrape always sees fresh numbers.  Never raises."""
+    try:
+        tracing.set_gauge("host_rss_bytes", float(host_rss_bytes()))
+        for rec in device_snapshot():
+            labels = {"device": rec["device"]}
+            tracing.set_gauge_labeled("hbm_bytes_in_use", labels,
+                                      float(rec["bytes_in_use"]))
+            tracing.set_gauge_labeled("hbm_peak_bytes_in_use", labels,
+                                      float(rec["peak_bytes_in_use"]))
+            if rec["bytes_limit"]:
+                tracing.set_gauge_labeled("hbm_bytes_limit", labels,
+                                          float(rec["bytes_limit"]))
+    except Exception:  # noqa: BLE001 — gauges are best-effort
+        pass
+
+
+def observe_route(route: str) -> None:
+    """Record the device-memory high-water mark attributable to ``route``
+    (stepwise / fused / chunked / sharded / sharded_batch): called right
+    after a route finishes, while its peak is the freshest thing in
+    ``peak_bytes_in_use``.  The gauge keeps the max ever seen per route —
+    peaks are ratchets, not samples."""
+    try:
+        snap = device_snapshot()
+        if not snap:
+            return
+        peak = max(rec["peak_bytes_in_use"] for rec in snap)
+        in_use = max(rec["bytes_in_use"] for rec in snap)
+        labels = {"route": route}
+        tracing.max_gauge_labeled("route_hbm_peak_bytes", labels, float(peak))
+        tracing.set_gauge_labeled("route_hbm_bytes_in_use", labels,
+                                  float(in_use))
+    except Exception:  # noqa: BLE001 — gauges are best-effort
+        pass
+
+
+# --- compiled-executable cost/memory analysis (XLA's static accounting) ---
+
+
+def exec_analysis_enabled() -> bool:
+    """Per-bucket executable analysis costs one extra AOT compile per shape
+    bucket (amortised by the persistent compile cache the daemon enables);
+    ``ICT_EXEC_ANALYSIS=0`` opts out for operators who want zero extra
+    compiles near a scarce tunnel window."""
+    return os.environ.get("ICT_EXEC_ANALYSIS", "1") != "0"
+
+
+def executable_analysis(compiled) -> dict:
+    """The JSON-ready facts from one ``jax.stages.Compiled``: FLOPs and
+    bytes accessed from ``cost_analysis()``, the buffer-assignment split
+    from ``memory_analysis()``.  Missing halves are omitted, not fatal —
+    both surfaces vary by backend and jax version."""
+    out: dict = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+            ca = ca[0] if ca else {}
+        if ca:
+            if "flops" in ca:
+                out["flops"] = float(ca["flops"])
+            if "bytes accessed" in ca:
+                out["bytes_accessed"] = float(ca["bytes accessed"])
+    except Exception:  # noqa: BLE001 — the other half may still land
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        out["argument_bytes"] = int(ma.argument_size_in_bytes)
+        out["output_bytes"] = int(ma.output_size_in_bytes)
+        out["temp_bytes"] = int(ma.temp_size_in_bytes)
+        out["generated_code_bytes"] = int(ma.generated_code_size_in_bytes)
+        out["peak_bytes"] = (out["argument_bytes"] + out["output_bytes"]
+                             + out["temp_bytes"])
+    except Exception:  # noqa: BLE001 — cost half alone is still valuable
+        pass
+    return out
+
+
+def note_executable(shape_bucket: str, compiled) -> dict:
+    """Record one compiled executable's analysis under its shape-bucket
+    label: registry (job manifests, bench payload) + labeled gauges
+    (``/metrics``).  Re-noting a bucket overwrites — the analysis is a
+    static fact of (shape, route), so the last writer agrees with every
+    earlier one."""
+    analysis = executable_analysis(compiled)
+    if not analysis:
+        return analysis
+    _exec_registry[shape_bucket] = analysis
+    labels = {"shape_bucket": shape_bucket}
+    for key, family in (("bytes_accessed", "executable_bytes_accessed"),
+                        ("flops", "executable_flops"),
+                        ("temp_bytes", "executable_temp_bytes"),
+                        ("peak_bytes", "executable_peak_bytes")):
+        if key in analysis:
+            tracing.set_gauge_labeled(family, labels, float(analysis[key]))
+    return analysis
+
+
+def executables_snapshot() -> dict[str, dict]:
+    return {k: dict(v) for k, v in sorted(_exec_registry.items())}
+
+
+def analyze_batch_route(batch_shape, cfg) -> dict | None:
+    """Static analysis of the serving daemon's bucket executable — the
+    vmapped fused loop at ``batch_shape`` = (batch, nsub, nchan, nbin) —
+    memoized per shape bucket.  The AOT lower().compile() runs on the live
+    backend (abstract avals, no device buffers), so on TPU the numbers
+    reflect real fusion and buffer assignment; with the persistent compile
+    cache on (the daemon default) the duplicate compile is mostly a disk
+    read.  Returns the analysis dict, or None when disabled/failed."""
+    if not exec_analysis_enabled() or not backend_live():
+        return None
+    bucket = tracing.shape_bucket_label(batch_shape)
+    if bucket in _exec_registry:
+        return _exec_registry[bucket]
+    try:
+        import jax
+        import numpy as np
+
+        from iterative_cleaner_tpu.parallel.sharded import batched_fused_clean
+
+        b, nsub, nchan, nbin = (int(v) for v in batch_shape)
+        D = jax.ShapeDtypeStruct((b, nsub, nchan, nbin), np.float32)
+        w = jax.ShapeDtypeStruct((b, nsub, nchan), np.float32)
+        v = jax.ShapeDtypeStruct((b, nsub, nchan), np.bool_)
+        s = jax.ShapeDtypeStruct((), np.float32)
+        with tracing.phase("exec_analysis"):
+            compiled = batched_fused_clean.lower(
+                D, w, v, s, s, max_iter=int(cfg.max_iter),
+                pulse_region=tuple(cfg.pulse_region)).compile()
+        return note_executable(bucket, compiled) or None
+    except Exception:  # noqa: BLE001 — analysis is best-effort
+        return None
+
+
+def memory_report() -> dict:
+    """The JSON block bench.py carries on every exit path and operators
+    read off job manifests: host RSS, per-device HBM view, and every
+    executable analysis recorded so far."""
+    report: dict = {"host_rss_bytes": host_rss_bytes()}
+    devices = device_snapshot()
+    if devices:
+        report["devices"] = devices
+    execs = executables_snapshot()
+    if execs:
+        report["executables"] = execs
+    return report
+
+
+def reset_for_tests() -> None:
+    _exec_registry.clear()
+    _stats_unsupported.clear()

@@ -19,6 +19,12 @@ snapshot moved to ``/metrics.json``).  Three metric classes:
 - **labeled counters** — ``ict_<family>{label="..."}`` from
   tracing.count_labeled (compiles / compile seconds per ``shape_bucket``,
   jobs per ``route``, …).
+- **gauges** — flat (``ict_host_rss_bytes``) and labeled
+  (``ict_hbm_bytes_in_use{device=...}``,
+  ``ict_route_hbm_peak_bytes{route=...}``,
+  ``ict_executable_bytes_accessed{shape_bucket=...}``) from
+  tracing.set_gauge / set_gauge_labeled / max_gauge_labeled — the
+  memory/cost accounting of obs/memory.py.
 """
 
 from __future__ import annotations
@@ -48,7 +54,8 @@ def _labels(pairs) -> str:
 
 def render_prometheus() -> str:
     """One consistent scrape of every registry, Prometheus text format."""
-    counters, labeled, hists = tracing.registry_snapshot()
+    counters, labeled, gauges, labeled_gauges, hists = (
+        tracing.registry_snapshot())
     lines: list[str] = []
 
     # --- phase latency histograms (cumulative buckets, label: phase) ---
@@ -83,12 +90,25 @@ def render_prometheus() -> str:
         lines.append(f"# TYPE ict_{name} {kind}")
         lines.append(f"ict_{name} {_fmt(value)}")
 
+    # --- flat gauges (set_gauge: point-in-time facts like host RSS) ---
+    for name, value in gauges.items():
+        lines.append(f"# TYPE ict_{name} gauge")
+        lines.append(f"ict_{name} {_fmt(value)}")
+
     # --- labeled counters (grouped per family for one TYPE line) ---
     seen_families: set[str] = set()
     for (family, label_pairs), value in labeled.items():
         if family not in seen_families:
             seen_families.add(family)
             lines.append(f"# TYPE ict_{family} counter")
+        lines.append(f"ict_{family}{_labels(label_pairs)} {_fmt(value)}")
+
+    # --- labeled gauges (device / route / shape_bucket memory views) ---
+    seen_families.clear()
+    for (family, label_pairs), value in labeled_gauges.items():
+        if family not in seen_families:
+            seen_families.add(family)
+            lines.append(f"# TYPE ict_{family} gauge")
         lines.append(f"ict_{family}{_labels(label_pairs)} {_fmt(value)}")
 
     return "\n".join(lines) + "\n"

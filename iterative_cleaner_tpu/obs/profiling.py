@@ -1,0 +1,182 @@
+"""On-demand ``jax.profiler`` capture — the ``trace_dir`` one-shot grown
+into a facility.
+
+The CLI's ``--trace DIR`` (config.trace_dir) wraps one run in one trace and
+exits; a long-lived daemon needs the opposite shape: start a *bounded*
+capture around whatever is in flight right now (``POST /debug/profile``),
+list the artifacts later (``GET /debug/profiles``), and capture one
+specific job's dispatch when the submitter asked for it
+(``POST /jobs {"path": ..., "profile": true}`` — the artifact directory is
+recorded on the job's spool manifest).  View artifacts with tensorboard or
+xprof, exactly like the one-shot's.
+
+The TSL profiler behind ``jax.profiler.start_trace`` is process-global and
+refuses to nest, so one lock serializes every capture in the process: a
+second ``POST /debug/profile`` gets 409, and a per-job capture that finds
+the profiler busy skips silently (noted on the flight recorder) rather
+than failing the job.  Every timed capture is bounded
+(:func:`max_capture_s`, default 60 s) — an operator typo must not leave a
+daemon writing trace events forever.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+from iterative_cleaner_tpu.obs import flight
+
+DEFAULT_MAX_CAPTURE_S = 60.0
+
+_lock = threading.Lock()          # held only to mutate _active, never I/O
+_active: dict | None = None       # {"dir", "started_s", "until_s", "timer"}
+
+
+def max_capture_s() -> float:
+    try:
+        v = float(os.environ.get("ICT_PROFILE_MAX_S", DEFAULT_MAX_CAPTURE_S))
+    except ValueError:
+        return DEFAULT_MAX_CAPTURE_S
+    return v if v > 0 else DEFAULT_MAX_CAPTURE_S
+
+
+def active() -> dict | None:
+    """The in-flight capture (dir / started_s / until_s), or None."""
+    with _lock:
+        if _active is None:
+            return None
+        return {k: _active[k] for k in ("dir", "started_s", "until_s")}
+
+
+def start(root: str, duration_s: float = 5.0, tag: str = "capture") -> dict:
+    """Begin a bounded capture into a fresh directory under ``root``; a
+    timer stops it after ``duration_s`` (clamped to :func:`max_capture_s`)
+    unless :func:`stop` is called first.  Raises RuntimeError when a
+    capture is already running — the profiler is process-global."""
+    duration_s = min(max(float(duration_s), 0.1), max_capture_s())
+    out_dir = os.path.join(
+        root, f"{int(time.time() * 1000):013d}-{tag}")
+    with _lock:
+        global _active
+        if _active is not None:
+            raise RuntimeError(
+                f"a profiler capture is already running ({_active['dir']}); "
+                "stop it or wait for its deadline")
+        os.makedirs(out_dir, exist_ok=True)
+        import jax
+
+        jax.profiler.start_trace(out_dir)
+        timer = threading.Timer(duration_s, _deadline_stop, args=(out_dir,))
+        timer.daemon = True
+        now = time.time()
+        _active = {"dir": out_dir, "started_s": now,
+                   "until_s": now + duration_s, "timer": timer}
+        timer.start()
+    flight.note("profile_start", dir=out_dir, duration_s=duration_s)
+    return {"dir": out_dir, "duration_s": duration_s}
+
+
+def stop(expected_dir: str | None = None) -> dict | None:
+    """End the running capture; returns its record or None when idle.
+
+    ``expected_dir`` makes the stop an *ownership-checked* one: a caller
+    whose capture may have already been ended by the deadline timer (the
+    per-job ``maybe_capture``, the timer itself) passes the dir it
+    started, and a mismatch no-ops — otherwise a late finally/timer would
+    truncate an unrelated capture an operator started in the meantime."""
+    with _lock:
+        global _active
+        if _active is None:
+            return None
+        if expected_dir is not None and _active["dir"] != expected_dir:
+            return None
+        rec = _active
+        _active = None
+        rec["timer"].cancel()
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as exc:  # noqa: BLE001 — a failed stop must not
+            # wedge the facility: the state is cleared either way, and the
+            # failure is on the flight record for the post-mortem.
+            flight.note("profile_stop_failed", dir=rec["dir"],
+                        error=repr(exc))
+            return {"dir": rec["dir"], "error": repr(exc)}
+    flight.note("profile_stop", dir=rec["dir"],
+                duration_s=round(time.time() - rec["started_s"], 3))
+    return {"dir": rec["dir"],
+            "duration_s": round(time.time() - rec["started_s"], 3)}
+
+
+def _deadline_stop(out_dir: str) -> None:
+    stop(expected_dir=out_dir)
+
+
+@contextlib.contextmanager
+def maybe_capture(root: str, tag: str, want: bool = True):
+    """Per-job capture around a block: yields the artifact directory, or
+    None when not wanted / the profiler is busy (skipped, never queued —
+    the job's latency contract beats its optional profile)."""
+    if not want:
+        yield None
+        return
+    try:
+        rec = start(root, duration_s=max_capture_s(), tag=tag)
+    except RuntimeError:
+        flight.note("profile_skipped_busy", tag=tag)
+        yield None
+        return
+    except Exception as exc:  # noqa: BLE001 — profiling is best-effort
+        flight.note("profile_start_failed", tag=tag, error=repr(exc))
+        yield None
+        return
+    try:
+        yield rec["dir"]
+    finally:
+        stop(expected_dir=rec["dir"])
+
+
+def list_profiles(root: str) -> list[dict]:
+    """Artifact directories under ``root`` (newest first): name, total
+    bytes, file count, mtime — enough to pick one to download."""
+    out = []
+    try:
+        names = sorted(os.listdir(root), reverse=True)
+    except OSError:
+        return out
+    for name in names:
+        path = os.path.join(root, name)
+        if not os.path.isdir(path):
+            continue
+        nbytes = nfiles = 0
+        mtime = 0.0
+        for dirpath, _dirs, files in os.walk(path):
+            for f in files:
+                try:
+                    st = os.stat(os.path.join(dirpath, f))
+                except OSError:
+                    continue
+                nbytes += st.st_size
+                nfiles += 1
+                mtime = max(mtime, st.st_mtime)
+        out.append({"name": name, "bytes": nbytes, "files": nfiles,
+                    "mtime": round(mtime, 3)})
+    return out
+
+
+@contextlib.contextmanager
+def profile_trace(trace_dir: str | None):
+    """The original one-shot (config.trace_dir / CLI ``--trace``): a
+    jax.profiler trace around a block when ``trace_dir`` is set, no-op
+    otherwise.  Lives here with the rest of the capture machinery;
+    :mod:`.tracing` re-exports it for its historical import sites."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield

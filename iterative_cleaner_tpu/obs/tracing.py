@@ -24,18 +24,12 @@ import contextlib
 import threading
 import time
 
+from iterative_cleaner_tpu.obs import flight
 
-@contextlib.contextmanager
-def profile_trace(trace_dir: str | None):
-    """jax.profiler trace around a block when trace_dir is set (view with
-    tensorboard or xprof); no-op otherwise."""
-    if not trace_dir:
-        yield
-        return
-    import jax
-
-    with jax.profiler.trace(trace_dir):
-        yield
+# The one-shot profiler context grew into obs/profiling.py (the daemon's
+# bounded-capture facility); re-exported here for its historical import
+# sites (driver.py, utils/tracing shim).
+from iterative_cleaner_tpu.obs.profiling import profile_trace  # noqa: F401
 
 
 # --- the registries (one lock: a /metrics scrape sees a consistent cut) ---
@@ -49,6 +43,8 @@ HIST_BOUNDS: tuple[float, ...] = tuple(2.0 ** e for e in range(-10, 6))
 
 _counters: dict[str, float] = {}
 _labeled: dict[tuple[str, tuple[tuple[str, str], ...]], float] = {}
+_gauges: dict[str, float] = {}
+_labeled_gauges: dict[tuple[str, tuple[tuple[str, str], ...]], float] = {}
 _hists: dict[str, list[int]] = {}
 _counters_lock = threading.Lock()
 
@@ -79,6 +75,35 @@ def count_labeled(family: str, labels: dict[str, str], inc: float = 1.0) -> None
         _labeled[key] = _labeled.get(key, 0.0) + inc
 
 
+def set_gauge(name: str, value: float) -> None:
+    """Set the absolute value of the gauge ``name`` (last write wins — the
+    register for point-in-time facts like host RSS, where a counter's
+    only-up contract would lie)."""
+    with _counters_lock:
+        _gauges[name] = float(value)
+
+
+def set_gauge_labeled(family: str, labels: dict[str, str],
+                      value: float) -> None:
+    """Labeled gauge (device / route / shape_bucket dimensions), absolute
+    value, last write wins.  Same low-cardinality expectation as
+    :func:`count_labeled`."""
+    key = (family, tuple(sorted((str(k), str(v)) for k, v in labels.items())))
+    with _counters_lock:
+        _labeled_gauges[key] = float(value)
+
+
+def max_gauge_labeled(family: str, labels: dict[str, str],
+                      value: float) -> None:
+    """Labeled gauge that only ratchets upward — high-water marks
+    (per-route peak HBM) where a later, lower sample must not erase the
+    peak the operator is alerting on."""
+    key = (family, tuple(sorted((str(k), str(v)) for k, v in labels.items())))
+    with _counters_lock:
+        if float(value) > _labeled_gauges.get(key, float("-inf")):
+            _labeled_gauges[key] = float(value)
+
+
 def observe_phase(name: str, seconds: float, error: bool = False) -> None:
     """Record one completed phase: total seconds + occurrence count + the
     worst single occurrence (``<name>_max_s``) + one log2 histogram bucket.
@@ -98,6 +123,9 @@ def observe_phase(name: str, seconds: float, error: bool = False) -> None:
         if hist is None:
             hist = _hists[name] = [0] * (len(HIST_BOUNDS) + 1)
         hist[_bucket_index(seconds)] += 1
+    # Outside the lock: the flight recorder (obs/flight) keeps its own —
+    # phase timings are the "what was it doing" half of a post-mortem ring.
+    flight.note_phase(name, seconds, error=error)
 
 
 @contextlib.contextmanager
@@ -136,16 +164,24 @@ def histograms_snapshot() -> dict[str, list[int]]:
         return {k: list(v) for k, v in sorted(_hists.items())}
 
 
-def registry_snapshot() -> tuple[dict, dict, dict]:
-    """(counters, labeled, histograms) under ONE lock hold — the scrape
-    path's view, so a histogram's +Inf bucket can never disagree with its
-    ``_n`` counter mid-observation."""
+def registry_snapshot() -> tuple[dict, dict, dict, dict, dict]:
+    """(counters, labeled, gauges, labeled_gauges, histograms) under ONE
+    lock hold — the scrape path's view, so a histogram's +Inf bucket can
+    never disagree with its ``_n`` counter mid-observation."""
     with _counters_lock:
         return (
             dict(sorted(_counters.items())),
             dict(sorted(_labeled.items())),
+            dict(sorted(_gauges.items())),
+            dict(sorted(_labeled_gauges.items())),
             {k: list(v) for k, v in sorted(_hists.items())},
         )
+
+
+def gauges_snapshot() -> tuple[dict, dict]:
+    """Point-in-time copy of the flat and labeled gauge registries."""
+    with _counters_lock:
+        return dict(sorted(_gauges.items())), dict(sorted(_labeled_gauges.items()))
 
 
 def snapshot(prefix: str = "") -> dict[str, float]:
@@ -170,6 +206,8 @@ def reset_counters() -> None:
     with _counters_lock:
         _counters.clear()
         _labeled.clear()
+        _gauges.clear()
+        _labeled_gauges.clear()
         _hists.clear()
 
 

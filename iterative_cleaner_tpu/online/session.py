@@ -133,7 +133,7 @@ class OnlineSession:
             alert.latency_s = time.perf_counter() - t0
         tracing.count("online_blocks_ingested")
         tracing.count("online_zap_alerts", alert.n_new_zaps)
-        if events.enabled():
+        if events.active():
             # Inherits the session's trace context (service/sessions.py and
             # the --follow driver bind it around ingest).
             events.emit("online_block", block_index=alert.block_index,

@@ -15,9 +15,9 @@ abort, while the cost of sharding unnecessarily is a few all-gathers.
 
 from __future__ import annotations
 
-import os
-
 import jax
+
+from iterative_cleaner_tpu.obs import memory as obs_memory
 
 # Peak device working set of the fused kernel, in cube-sized units: the cube
 # itself, the complex64 rfft of the centred cube (nbin/2+1 bins at 8 bytes
@@ -32,8 +32,6 @@ PEAK_CUBE_FACTOR = 3.5
 # Fraction of reported device memory treated as usable (XLA reserves some,
 # and fragmentation is real).
 HBM_USABLE_FRACTION = 0.9
-
-_ENV_OVERRIDE = "ICT_HBM_BYTES"
 
 
 def default_devices():
@@ -56,20 +54,14 @@ def device_memory_bytes(device=None) -> int | None:
 
     Resolution order: the ``ICT_HBM_BYTES`` env override (tests, and hosts
     where the runtime misreports), the device's ``memory_stats()`` limit
-    (TPU), else None (unknown — e.g. CPU backends report no limit)."""
-    env = os.environ.get(_ENV_OVERRIDE)
-    if env:
-        return int(env)
-    if device is None:
-        device = default_devices()[0]
-    try:
-        stats = device.memory_stats()
-    except Exception:  # noqa: BLE001 — backend without memory introspection
-        return None
-    if stats is None:
-        return None
-    limit = stats.get("bytes_limit")
-    return int(limit) if limit else None
+    (TPU), else None (unknown — e.g. CPU backends report no limit).
+
+    Delegates to :mod:`iterative_cleaner_tpu.obs.memory` — the single
+    owner of every ``memory_stats()`` read — so the autoshard routing
+    decision and the gauges exported on ``/metrics`` can never disagree
+    about what a device reported."""
+    return obs_memory.device_memory_bytes(
+        device, default_device_fn=lambda: default_devices()[0])
 
 
 def working_set_bytes(shape: tuple[int, ...], itemsize: int = 4) -> int:

@@ -26,6 +26,18 @@ docs/OBSERVABILITY.md):
                             counts, ``*_err_n`` failures, ``*_max_s`` worst
                             single occurrence, ``service_*``/``online_*``
                             events)
+- ``POST /debug/profile``   start a bounded ``jax.profiler`` capture around
+                            whatever is in flight (body: optional
+                            ``{"duration_s": 5}``; ``{"stop": true}`` ends
+                            the running one); 409 when a capture is already
+                            running (obs/profiling.py)
+- ``GET  /debug/profiles``  list capture artifacts (name/bytes/files/mtime)
+                            plus the active capture, if any
+- ``GET  /debug/flight``    the always-on flight-recorder ring of recent
+                            events/phase timings (obs/flight.py) — the live
+                            view of what fault-ladder/SIGTERM dumps write
+- ``GET  /debug/memory``    host RSS + per-device HBM view + recorded
+                            executable analyses (obs/memory.py)
 
 ThreadingHTTPServer: each request gets a thread, so a slow client cannot
 stall the poll loop; all handlers only touch thread-safe service surfaces
@@ -139,6 +151,25 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(200, job.trace_dict())
             else:
                 self._reply(200, job.to_dict())
+        elif self.path == "/debug/profiles":
+            from iterative_cleaner_tpu.obs import profiling
+
+            self._reply(200, {
+                "active": profiling.active(),
+                "profiles": profiling.list_profiles(service.profile_root),
+            })
+        elif self.path == "/debug/flight":
+            from iterative_cleaner_tpu.obs import flight
+
+            self._reply(200, {
+                "enabled": flight.enabled(),
+                "capacity": flight.capacity(),
+                "events": flight.snapshot(),
+            })
+        elif self.path == "/debug/memory":
+            from iterative_cleaner_tpu.obs import memory as obs_memory
+
+            self._reply(200, obs_memory.memory_report())
         elif self.path.startswith("/sessions/"):
             sid = self.path[len("/sessions/"):]
             self._session_call(lambda s: s.manifest(sid))
@@ -152,6 +183,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path == "/sessions":
             self._post_session_open()
+            return
+        if self.path == "/debug/profile":
+            self._post_debug_profile()
             return
         if self.path.startswith("/sessions/"):
             rest = self.path[len("/sessions/"):]
@@ -169,6 +203,40 @@ class _Handler(BaseHTTPRequestHandler):
                 return
         self._reply(404, {"error": f"no such route {self.path!r}"})
 
+    # --- debug: profiler capture (obs/profiling) ---
+
+    def _post_debug_profile(self) -> None:
+        service = self.server.service
+        from iterative_cleaner_tpu.obs import profiling
+
+        try:
+            body = json.loads(self._read_body(1 << 20) or b"{}")
+            if not isinstance(body, dict):
+                raise TypeError("body must be a JSON object")
+            stop = bool(body.get("stop", False))
+            duration_s = float(body.get("duration_s", 5.0))
+        except (ValueError, TypeError) as exc:
+            self._reply(400, {"error": f"bad profile request: {exc!r}; "
+                                       'expected {"duration_s": 5} or '
+                                       '{"stop": true}'})
+            return
+        if stop:
+            rec = profiling.stop()
+            if rec is None:
+                self._reply(409, {"error": "no capture is running"})
+            else:
+                self._reply(200, rec)
+            return
+        try:
+            rec = profiling.start(service.profile_root, duration_s=duration_s)
+        except RuntimeError as exc:   # capture already running
+            self._reply(409, {"error": str(exc)})
+            return
+        except Exception as exc:  # noqa: BLE001 — the client deserves a 500
+            self._reply(500, {"error": f"profiler start failed: {exc}"})
+            return
+        self._reply(200, rec)
+
     # --- jobs ---
 
     def _post_job(self) -> None:
@@ -176,6 +244,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             body = json.loads(self._read_body(1 << 20) or b"{}")
             path = body["path"]
+            profile = bool(body.get("profile", False))
         # TypeError covers valid-JSON non-dict bodies ('[]', '5', 'null'):
         # the client gets a 400, not a dropped socket.
         except (ValueError, KeyError, TypeError) as exc:
@@ -185,7 +254,7 @@ class _Handler(BaseHTTPRequestHandler):
         from iterative_cleaner_tpu.service.daemon import ServiceBusy
 
         try:
-            job = service.submit(str(path))
+            job = service.submit(str(path), profile=profile)
         except ServiceBusy as exc:
             self._reply(503, {"error": str(exc)}, headers={"Retry-After": "5"})
             return

@@ -30,7 +30,12 @@ import time
 from dataclasses import dataclass, field
 
 from iterative_cleaner_tpu.config import CleanConfig
-from iterative_cleaner_tpu.obs import events, tracing
+from iterative_cleaner_tpu.obs import (
+    events,
+    flight,
+    memory as obs_memory,
+    tracing,
+)
 from iterative_cleaner_tpu.service.jobs import TERMINAL, Job, JobSpool
 from iterative_cleaner_tpu.service.scheduler import ShapeBucketScheduler
 from iterative_cleaner_tpu.service.worker import DispatchWorker
@@ -97,6 +102,12 @@ class CleaningService:
         self.scheduler = None
         self.worker = None
         self.sessions = None
+        # Device-level observability artifacts live under the spool (the
+        # single-daemon flock already covers it): profiler captures
+        # (obs/profiling — POST /debug/profile, per-job capture) and
+        # flight-recorder dumps (obs/flight — fault-ladder trips, SIGTERM).
+        self.profile_root = os.path.join(serve_cfg.spool_dir, "profiles")
+        self.flight_dir = os.path.join(serve_cfg.spool_dir, "flight")
 
     # --- lifecycle ---
 
@@ -121,8 +132,14 @@ class CleaningService:
 
     def _start_locked(self) -> None:
         self.started_s = time.time()
-        if self.serve_cfg.telemetry:
-            events.configure(self.serve_cfg.telemetry)
+        # Unconditional: telemetry="" must MEAN "honor ICT_TELEMETRY /
+        # disabled" (the ServeConfig contract) even when an earlier
+        # service in this process configured an explicit sink — a
+        # restarted daemon must not silently inherit its predecessor's
+        # log file.
+        events.configure(self.serve_cfg.telemetry or None)
+        flight.note("daemon_starting", spool=self.spool.root,
+                    backend=self.backend_mode)
         if self.backend_mode == "jax":
             # Compile accounting on /metrics (compiles, compile seconds per
             # shape bucket, persistent-cache events).  JAX path only: the
@@ -146,8 +163,16 @@ class CleaningService:
         if self.backend_mode == "jax":
             if self.mesh is None:
                 from iterative_cleaner_tpu.parallel.mesh import make_mesh
+                from iterative_cleaner_tpu.utils.device_probe import (
+                    init_watchdog,
+                )
 
-                self.mesh = make_mesh()
+                # make_mesh is this daemon's first in-process jax.devices():
+                # the init watchdog turns a wedged-tunnel freeze HERE into a
+                # structured warning (ICT_INIT_TIMEOUT_S) instead of a
+                # silent never-came-up.
+                with init_watchdog("ict-serve backend init"):
+                    self.mesh = make_mesh()
             cap = self.serve_cfg.bucket_cap or max(int(self.mesh.shape["dp"]), 1)
         self.scheduler = ShapeBucketScheduler(
             cap, self.serve_cfg.deadline_s, self._on_flush)
@@ -258,15 +283,17 @@ class CleaningService:
 
     # --- submission / inspection (the API's surface) ---
 
-    def submit(self, path: str) -> Job:
+    def submit(self, path: str, profile: bool = False) -> Job:
         path = self._check_root(path)
         from iterative_cleaner_tpu.service.jobs import new_job_id
 
         # The trace context is minted HERE, at the entry point, and rides
         # on the job through every layer (admission, dispatch, iteration
         # events) — echoed in the 202 response and the X-ICT-Trace header.
+        # ``profile`` asks for a jax.profiler capture around this job's
+        # dispatch (obs/profiling); the artifact dir lands on the manifest.
         job = Job(id=new_job_id(), path=path, submitted_s=time.time(),
-                  trace_id=events.new_trace_id())
+                  trace_id=events.new_trace_id(), profile=bool(profile))
         # Cap check and insert under ONE lock hold: concurrent POST handler
         # threads must not all pass the check before any of them inserts
         # (the cap is the OOM backpressure — a race would breach it).
@@ -291,7 +318,7 @@ class CleaningService:
                 self._jobs.pop(job.id, None)
             raise
         tracing.count("service_jobs_submitted")
-        if events.enabled():
+        if events.active():
             events.emit("job_submitted", trace_id=job.trace_id,
                         job_id=job.id, path=path)
         self._load_q.put(job)
@@ -390,8 +417,16 @@ class CleaningService:
 
     def _tick_loop(self) -> None:
         interval = min(max(self.serve_cfg.deadline_s / 4, 0.01), 0.25)
+        last_gauges = 0.0
         while not self._stop_evt.wait(interval):
             self.scheduler.tick()
+            # Keep the memory gauges (/metrics: host RSS, per-device
+            # current/peak HBM) no staler than a couple of seconds; the
+            # read is a stats-dict fetch, not device work.
+            now = time.monotonic()
+            if now - last_gauges >= 2.0:
+                last_gauges = now
+                obs_memory.update_process_gauges()
 
     def _on_flush(self, entries) -> None:
         tracing.count("service_buckets_dispatched")
@@ -406,6 +441,11 @@ class CleaningService:
                 and self._consecutive_failures >= self.serve_cfg.demote_after):
             self.backend_mode = "numpy"
             tracing.count("service_backend_demotions")
+            # The top rung of the fault ladder: dump the flight ring — the
+            # post-mortem of what led to a service-wide demotion is worth a
+            # file even when nobody configured telemetry.
+            flight.note("service_demoted", error=str(exc))
+            flight.dump(f"service_demotion: {exc}", self.flight_dir)
             print(f"ict-serve: {self._consecutive_failures} consecutive "
                   f"bucket dispatches failed (last: {exc}); demoting the "
                   "service to the numpy oracle backend", file=sys.stderr)
@@ -616,6 +656,24 @@ def serve_main(argv: list[str] | None = None) -> int:
         # the operator contract is a one-line error + rc 1, not a traceback.
         print(f"error: {exc}", file=sys.stderr)
         return 1
+    # SIGTERM (the orchestrator's stop signal) dumps the flight ring before
+    # the graceful shutdown: "what was the daemon doing when it was killed"
+    # becomes a file in the spool instead of a guess.  Registered only for
+    # the real daemon run (not --smoke, not library embedders), and only
+    # from the main thread (signal.signal refuses elsewhere).
+    import signal
+
+    def _on_sigterm(signum, frame):
+        path = flight.dump("SIGTERM", service.flight_dir)
+        print("ict-serve: SIGTERM — shutting down (unfinished jobs stay in "
+              f"the spool{'; flight ring at ' + path if path else ''})",
+              file=sys.stderr)
+        raise SystemExit(0)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):  # noqa: PERF203 — non-main-thread embed
+        pass
     try:
         while True:
             time.sleep(3600)

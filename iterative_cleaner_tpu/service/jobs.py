@@ -50,6 +50,14 @@ class Job:
                                     # minted at admission, echoed in every
                                     # HTTP response and event-log line
     termination: str = ""           # forensics: fixed_point | cycle | max_iter
+    profile: bool = False           # submitter asked for a jax.profiler
+                                    # capture around this job's dispatch
+                                    # (obs/profiling.py)
+    profile_dir: str = ""           # capture artifact directory, once taken
+    # XLA's static accounting of the executable that served this job's
+    # shape bucket (obs/memory.py: bytes accessed, FLOPs, buffer split) —
+    # attached when exec analysis is enabled, persisted on the manifest.
+    exec_analysis: dict = field(default_factory=dict)
     # Per-iteration forensics records (obs.forensics.iteration_record dicts)
     # — served by GET /jobs/<id>/trace, EXCLUDED from to_dict so the job
     # manifest responses stay lean.

@@ -73,6 +73,17 @@ class WarmPool:
                     with tracing.compile_scope(
                             tracing.shape_bucket_label((bsz, *shape))):
                         sharded_clean(Db, w0b, self.cfg, self.mesh)
+                    # Startup is the right time to pay the per-bucket
+                    # executable analysis (obs/memory: bytes/FLOPs gauges
+                    # on /metrics, attached to manifests later): the
+                    # operator already opted into compile cost by
+                    # declaring the shape, and the memoized answer makes
+                    # the first real dispatch analysis-free.
+                    from iterative_cleaner_tpu.obs import (
+                        memory as obs_memory,
+                    )
+
+                    obs_memory.analyze_batch_route((bsz, *shape), self.cfg)
                     compiled += 1
                 except Exception as exc:  # noqa: BLE001 — best-effort, and
                     # per size: one failed compile must neither skip the

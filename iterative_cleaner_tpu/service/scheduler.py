@@ -79,7 +79,7 @@ class ShapeBucketScheduler:
         entry = Entry(job=job, archive=archive, D=D, w0=w0,
                       arrived_s=time.monotonic())
         job.shape = list(D.shape)
-        if events.enabled():
+        if events.active():
             events.emit("admission", trace_id=job.trace_id, job_id=job.id,
                         shape=list(D.shape))
         flush = None

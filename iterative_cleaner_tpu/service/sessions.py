@@ -133,7 +133,7 @@ class SessionManager:
                 self._out_paths[sid] = out_path
             self._trace_ids[sid] = trace_id
         tracing.count("online_sessions_opened")
-        if events.enabled():
+        if events.active():
             events.emit("session_opened", trace_id=trace_id, session_id=sid,
                         nchan=meta.nchan, nbin=meta.nbin)
         return self.manifest(sid)

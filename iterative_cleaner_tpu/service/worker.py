@@ -29,7 +29,14 @@ import time
 
 import numpy as np
 
-from iterative_cleaner_tpu.obs import events, forensics, tracing
+from iterative_cleaner_tpu.obs import (
+    events,
+    flight,
+    forensics,
+    memory as obs_memory,
+    profiling,
+    tracing,
+)
 from iterative_cleaner_tpu.service.jobs import TERMINAL, Job
 from iterative_cleaner_tpu.service.scheduler import Entry
 
@@ -73,15 +80,36 @@ class DispatchWorker(threading.Thread):
         for e in entries:
             e.job.state = "running"
             svc.spool.save(e.job)
-            if events.enabled():
+            if events.active():
                 events.emit("dispatch", trace_id=e.job.trace_id,
                             job_id=e.job.id, bucket_size=len(entries),
                             backend=svc.backend_mode)
+        # Per-job profiler capture (obs/profiling): requested at submit
+        # time, taken around this bucket's whole dispatch (device work is
+        # bucket-granular — the capture necessarily covers the siblings
+        # too, which the artifact dir's job tag makes plain).  Skipped
+        # silently when the profiler is busy with an operator capture.
+        want_profile = [e for e in entries if e.job.profile]
+        with profiling.maybe_capture(
+                svc.profile_root,
+                tag=want_profile[0].job.id if want_profile else "",
+                want=bool(want_profile)) as profile_dir:
+            if profile_dir:
+                for e in want_profile:
+                    e.job.profile_dir = profile_dir
+            self._dispatch_routed(entries)
+
+    def _dispatch_routed(self, entries: list[Entry]) -> None:
+        svc = self.service
         if svc.backend_mode == "jax":
             err = self._try_sharded(entries)
             if err is None:
                 return
             tracing.count("service_oracle_fallbacks")
+            # A fault-ladder trip is exactly the moment the flight ring
+            # exists for: persist what the daemon was doing (dispatches,
+            # phase timings, retries) next to the spool.
+            flight.dump(f"oracle_fallback: {err}", svc.flight_dir)
             print(f"ict-serve: sharded dispatch failed after retries ({err}); "
                   f"serving {len(entries)} job(s) via the numpy oracle",
                   file=sys.stderr)
@@ -176,6 +204,23 @@ class DispatchWorker(threading.Thread):
             tracing.observe_phase(
                 "service_dispatch", time.perf_counter() - t0 - emit_s[0],
                 error=not ok)
+            # Peak HBM attributable to the service's batched route, read
+            # while this dispatch is the freshest thing in the stats.
+            obs_memory.observe_route("sharded_batch")
+        # XLA's static cost/memory accounting of this bucket's executable,
+        # memoized per shape bucket (obs/memory; ICT_EXEC_ANALYSIS=0 opts
+        # out), AFTER the device work: the analysis AOT compile must delay
+        # telemetry, never the jobs.  Manifests were already written
+        # terminal by on_item, so the analysis is re-persisted onto them
+        # (GET /jobs/<id> falls back to the spool after retire()).
+        analysis = obs_memory.analyze_batch_route(Db.shape, svc.clean_cfg)
+        if analysis:
+            for e in entries:
+                e.job.exec_analysis = analysis
+                try:
+                    svc.spool.save(e.job)
+                except Exception:  # noqa: BLE001 — telemetry must not fail
+                    pass           # a job that already served its result
 
     def _clean_oracle(self, e: Entry, served_by: str = "oracle-fallback") -> None:
         """The numpy-oracle route, one job at a time (isolated).  Runs
@@ -224,7 +269,7 @@ class DispatchWorker(threading.Thread):
         job.termination = termination
         if iterations:
             job.timeline = [forensics.iteration_record(i) for i in iterations]
-            if emit_iteration_events and events.enabled():
+            if emit_iteration_events and events.active():
                 for rec in job.timeline:
                     events.emit("iteration", trace_id=job.trace_id,
                                 job_id=job.id, **rec)
@@ -234,7 +279,7 @@ class DispatchWorker(threading.Thread):
         svc.retire(job)
         tracing.count("service_jobs_done")
         tracing.count_labeled("jobs_served_total", {"route": served_by})
-        if events.enabled():
+        if events.active():
             events.emit("job_done", trace_id=job.trace_id, job_id=job.id,
                         served_by=served_by, loops=job.loops,
                         termination=termination,
@@ -251,7 +296,7 @@ class DispatchWorker(threading.Thread):
         job.state = "error"
         job.error = msg
         job.finished_s = time.time()
-        if events.enabled():
+        if events.active():
             events.emit("job_error", trace_id=job.trace_id, job_id=job.id,
                         error=msg)
         try:

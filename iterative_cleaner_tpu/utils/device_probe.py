@@ -14,9 +14,13 @@ CPU fallback actually stick.
 
 from __future__ import annotations
 
+import contextlib
+import json
 import os
 import subprocess
 import sys
+import threading
+import time
 
 # Platforms JAX itself provides; anything else in JAX_PLATFORMS is a
 # registered plugin (e.g. a tunneled remote device) — the only kind that
@@ -36,6 +40,78 @@ def probe_default_backend(timeout_s: float) -> str:
         return "ok" if out.returncode == 0 else "error"
     except subprocess.TimeoutExpired:
         return "hang"
+
+
+#: Default seconds before the backend-init watchdog speaks up
+#: (``ICT_INIT_TIMEOUT_S`` overrides; <= 0 disables).
+DEFAULT_INIT_TIMEOUT_S = 120.0
+
+
+@contextlib.contextmanager
+def init_watchdog(label: str = "jax backend init",
+                  timeout_s: float | None = None):
+    """Diagnose — don't prevent — the wedged-tunnel first-init hang.
+
+    The killable subprocess probe above is the *prevention*; this is the
+    *diagnosis* for every path that still reaches first ``jax.devices()``
+    in-process (probe disabled, probe passed but the tunnel wedged right
+    after, a non-CLI embedding).  A daemon thread watches the wrapped
+    block: if the backend is still not live after ``timeout_s``
+    (``ICT_INIT_TIMEOUT_S``, default 120), it logs ONE structured warning
+    (JSON on stderr) and drops a flight-recorder event, turning the silent
+    process-wide freeze into a diagnosable line.  It keeps polling and
+    stays silent if init completes (so wrapping a long compile or clean is
+    safe — liveness, not wall-clock, is the trigger); the context exit
+    always retires the thread."""
+    if timeout_s is None:
+        try:
+            timeout_s = float(os.environ.get("ICT_INIT_TIMEOUT_S",
+                                             DEFAULT_INIT_TIMEOUT_S))
+        except ValueError:
+            timeout_s = DEFAULT_INIT_TIMEOUT_S
+    if timeout_s <= 0 or _backend_liveness() == "live":
+        yield
+        return
+    done = threading.Event()
+
+    def _watch() -> None:
+        deadline = time.monotonic() + timeout_s
+        while not done.wait(min(timeout_s / 10, 1.0)):
+            if _backend_liveness() == "live":
+                return
+            if time.monotonic() >= deadline:
+                break
+        else:
+            return
+        if done.is_set() or _backend_liveness() == "live":
+            return
+        warning = {
+            "event": "backend_init_watchdog",
+            "label": label,
+            "timeout_s": timeout_s,
+            "hint": "first jax.devices() has been blocking longer than "
+                    "ICT_INIT_TIMEOUT_S — a wedged device tunnel hangs "
+                    "first backend init process-wide (CLAUDE.md quirk); "
+                    "set JAX_PLATFORMS=cpu before launch to force the "
+                    "CPU fallback",
+        }
+        print(f"warning: {json.dumps(warning)}", file=sys.stderr)
+        try:
+            from iterative_cleaner_tpu.obs import flight, tracing
+
+            flight.note("backend_init_watchdog", label=label,
+                        timeout_s=timeout_s)
+            tracing.count("backend_init_watchdog_fired")
+        except Exception:  # noqa: BLE001 — the stderr line already landed
+            pass
+
+    th = threading.Thread(target=_watch, daemon=True,
+                          name="ict-init-watchdog")
+    th.start()
+    try:
+        yield
+    finally:
+        done.set()
 
 
 def pin_cpu_backend() -> None:

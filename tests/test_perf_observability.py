@@ -1,0 +1,448 @@
+"""Device-level perf observability (the obs/ profiling + memory + flight
+rung): /debug endpoints end-to-end against an offline daemon, strict
+Prometheus grammar over the new gauges, flight-recorder dumps on injected
+worker faults, mask bit-identity with the recorder and profiler on, the
+backend-init watchdog, the autoshard/obs-memory unification, and the
+tools/perf_gate.py exit-code contract."""
+
+from __future__ import annotations
+
+import copy
+import importlib.util
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube
+from iterative_cleaner_tpu.io.npz import NpzIO
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.obs import (
+    events,
+    flight,
+    memory as obs_memory,
+    metrics,
+    profiling,
+    tracing,
+)
+from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+from test_observability import _parse_prometheus
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- flight recorder ---
+
+
+def test_flight_ring_records_phases_and_events():
+    flight.reset()
+    tracing.observe_phase("t_pobs_phase", 0.002)
+    events.emit("t_pobs_event", detail=7)   # no sink configured: flight only
+    recs = flight.snapshot()
+    assert any(r["event"] == "phase" and r["phase"] == "t_pobs_phase"
+               for r in recs)
+    assert any(r["event"] == "t_pobs_event" and r["detail"] == 7
+               for r in recs)
+
+
+def test_flight_ring_bounded_and_resizable(monkeypatch):
+    monkeypatch.setenv("ICT_FLIGHT_SIZE", "8")
+    flight.reset()
+    for i in range(50):
+        flight.note("t_pobs_fill", i=i)
+    recs = flight.snapshot()
+    assert len(recs) == 8
+    assert [r["i"] for r in recs] == list(range(42, 50))  # newest kept
+
+
+def test_flight_disabled_by_env(monkeypatch):
+    flight.reset()
+    monkeypatch.setenv("ICT_FLIGHT", "0")
+    flight.note("t_pobs_off")
+    events.emit("t_pobs_off_event")
+    assert flight.snapshot() == []
+    assert flight.dump("unit", "/nonexistent") is None
+
+
+def test_flight_dump_writes_and_sweeps(tmp_path):
+    flight.reset()
+    flight.note("t_pobs_dump", k=1)
+    d = str(tmp_path / "flight")
+    paths = []
+    for i in range(flight.MAX_DUMPS_KEPT + 3):
+        p = flight.dump(f"unit-{i}", d)
+        assert p is not None
+        paths.append(p)
+        time.sleep(0.002)  # unixms filenames must differ
+    kept = sorted(os.listdir(d))
+    assert len(kept) == flight.MAX_DUMPS_KEPT
+    with open(paths[-1]) as fh:
+        payload = json.load(fh)
+    assert payload["reason"] == f"unit-{flight.MAX_DUMPS_KEPT + 2}"
+    assert any(r["event"] == "t_pobs_dump" for r in payload["events"])
+
+
+# --- gauges on the Prometheus exposition ---
+
+
+def test_prometheus_gauges_strict_grammar():
+    tracing.set_gauge("t_pobs_rss_bytes", 12345.0)
+    tracing.set_gauge_labeled("t_pobs_hbm_in_use", {"device": "cpu:0"}, 17.0)
+    tracing.max_gauge_labeled("t_pobs_route_peak", {"route": "unit"}, 99.0)
+    tracing.max_gauge_labeled("t_pobs_route_peak", {"route": "unit"}, 50.0)
+    text = metrics.render_prometheus()
+    samples = _parse_prometheus(text)   # strict per-line regex
+    flat = {n: v for n, labels, v in samples if not labels}
+    assert flat["ict_t_pobs_rss_bytes"] == "12345"
+    assert ("ict_t_pobs_hbm_in_use", '{device="cpu:0"}', "17") in samples
+    # max_gauge ratchets: the later, lower write must not win
+    assert ("ict_t_pobs_route_peak", '{route="unit"}', "99") in samples
+    # TYPE lines declare gauges
+    assert "# TYPE ict_t_pobs_rss_bytes gauge" in text
+    assert "# TYPE ict_t_pobs_route_peak gauge" in text
+
+
+def test_memory_report_and_gauges_update():
+    obs_memory.update_process_gauges()
+    report = obs_memory.memory_report()
+    assert report["host_rss_bytes"] > 0
+    gauges, _labeled = tracing.gauges_snapshot()
+    assert gauges.get("host_rss_bytes", 0) > 0
+
+
+# --- autoshard unification ---
+
+
+def test_autoshard_delegates_to_obs_memory(monkeypatch):
+    from iterative_cleaner_tpu.parallel import autoshard
+
+    monkeypatch.setenv("ICT_HBM_BYTES", "424242")
+    # One resolver: the env override is honored by obs/memory, and
+    # autoshard sees exactly what the gauges layer would report.
+    assert obs_memory.device_memory_bytes() == 424242
+    assert autoshard.device_memory_bytes() == 424242
+    monkeypatch.delenv("ICT_HBM_BYTES")
+    sentinel = object()
+    monkeypatch.setattr(obs_memory, "device_memory_bytes",
+                        lambda device=None, default_device_fn=None: sentinel)
+    assert autoshard.device_memory_bytes() is sentinel
+
+
+# --- profiler capture facility ---
+
+
+def test_profiling_bounded_capture_and_listing(tmp_path):
+    root = str(tmp_path / "profiles")
+    rec = profiling.start(root, duration_s=30, tag="unit")
+    try:
+        assert profiling.active() is not None
+        with pytest.raises(RuntimeError):
+            profiling.start(root, duration_s=1)
+        # exercise the device while the capture is live
+        D, w0 = preprocess(make_archive(nsub=4, nchan=8, nbin=64, seed=3))
+        clean_cube(D, w0, CleanConfig(backend="jax", max_iter=2))
+    finally:
+        stopped = profiling.stop()
+    assert profiling.active() is None
+    assert profiling.stop() is None          # idempotent
+    assert stopped["dir"] == rec["dir"]
+    listed = profiling.list_profiles(root)
+    assert listed and listed[0]["name"] == os.path.basename(rec["dir"])
+    assert listed[0]["files"] > 0            # the trace actually wrote
+
+
+def test_profiling_duration_clamped(tmp_path, monkeypatch):
+    monkeypatch.setenv("ICT_PROFILE_MAX_S", "0.3")
+    rec = profiling.start(str(tmp_path), duration_s=9999, tag="clamp")
+    assert rec["duration_s"] <= 0.3
+    deadline = time.time() + 10
+    while profiling.active() is not None and time.time() < deadline:
+        time.sleep(0.05)
+    assert profiling.active() is None        # the deadline timer stopped it
+
+
+def test_maybe_capture_skips_when_busy(tmp_path):
+    profiling.start(str(tmp_path), duration_s=30, tag="owner")
+    try:
+        with profiling.maybe_capture(str(tmp_path), tag="job", want=True) as d:
+            assert d is None                 # busy -> skipped, not queued
+    finally:
+        profiling.stop()
+
+
+def test_stop_is_ownership_checked(tmp_path):
+    """A late stop from a capture the deadline timer already ended must
+    not truncate a newer, unrelated capture."""
+    first = profiling.start(str(tmp_path), duration_s=30, tag="first")
+    assert profiling.stop(expected_dir=first["dir"]) is not None
+    second = profiling.start(str(tmp_path), duration_s=30, tag="second")
+    try:
+        # the stale owner's stop no-ops; the new capture keeps running
+        assert profiling.stop(expected_dir=first["dir"]) is None
+        assert profiling.active()["dir"] == second["dir"]
+    finally:
+        assert profiling.stop(expected_dir=second["dir"]) is not None
+
+
+# --- masks stay bit-identical with the whole rung enabled ---
+
+
+def test_masks_bit_identical_with_flight_and_profiling(tmp_path, monkeypatch):
+    """The fuzz spot-check: ICT_FLIGHT=1 + a live profiler capture + memory
+    accounting, and every jax mode still reproduces the oracle's mask."""
+    from test_fuzz_equivalence import draw_case
+
+    monkeypatch.setenv("ICT_FLIGHT", "1")
+    flight.reset()
+    profiling.start(str(tmp_path / "prof"), duration_s=60, tag="parity")
+    try:
+        for seed in (7001, 7002):
+            archive, kw = draw_case(seed)
+            D, w0 = preprocess(archive)
+            res_np = clean_cube(D, w0, CleanConfig(backend="numpy", **kw))
+            obs_memory.update_process_gauges()
+            for name, cfg in (
+                ("stepwise", CleanConfig(backend="jax", **kw)),
+                ("fused", CleanConfig(backend="jax", fused=True, **kw)),
+                ("chunked", CleanConfig(backend="jax", chunk_block=3, **kw)),
+            ):
+                res = clean_cube(D, w0, cfg)
+                np.testing.assert_array_equal(
+                    res.weights, res_np.weights, err_msg=f"{name}@{seed}")
+                assert res.loops == res_np.loops, (name, seed)
+    finally:
+        profiling.stop()
+    # the rung actually observed the runs it was on for
+    assert any(r["event"] == "clean_route" for r in flight.snapshot())
+
+
+# --- daemon surface: /debug endpoints, per-job capture, fault dump ---
+
+
+def _start_service(tmp_path, **kw):
+    import jax
+
+    from iterative_cleaner_tpu.parallel.mesh import make_mesh
+    from iterative_cleaner_tpu.service import CleaningService, ServeConfig
+
+    mesh = make_mesh(8, devices=jax.devices("cpu"))
+    defaults = dict(spool_dir=str(tmp_path / "spool"), port=0,
+                    deadline_s=0.2, quiet=True,
+                    clean=CleanConfig(backend="jax", max_iter=3, quiet=True,
+                                      no_log=True))
+    defaults.update(kw)
+    svc = CleaningService(ServeConfig(**defaults), mesh=mesh)
+    svc.start()
+    return svc
+
+
+def _http_json(svc, route):
+    return json.load(urllib.request.urlopen(
+        f"http://127.0.0.1:{svc.port}{route}", timeout=30))
+
+
+def _http_post(svc, route, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{svc.port}{route}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.load(urllib.request.urlopen(req, timeout=30))
+
+
+def test_daemon_debug_profile_flight_and_job_capture(tmp_path):
+    flight.reset()
+    archive_path = str(tmp_path / "t.npz")
+    NpzIO().save(make_archive(nsub=8, nchan=16, nbin=64, seed=11),
+                 archive_path)
+    svc = _start_service(tmp_path)
+    try:
+        # operator capture: start, listed as active, 409 on overlap, stop
+        rec = _http_post(svc, "/debug/profile", {"duration_s": 30})
+        assert rec["dir"].startswith(svc.profile_root)
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            _http_post(svc, "/debug/profile", {"duration_s": 1})
+        assert exc_info.value.code == 409
+        listing = _http_json(svc, "/debug/profiles")
+        assert listing["active"] is not None
+        stopped = _http_post(svc, "/debug/profile", {"stop": True})
+        assert stopped["dir"] == rec["dir"]
+
+        # per-job capture requested at submit time
+        job = _http_post(svc, "/jobs", {"path": archive_path,
+                                        "profile": True})
+        assert job["profile"] is True
+        assert svc.drain(120)
+        done = _http_json(svc, f"/jobs/{job['id']}")
+        assert done["state"] == "done"
+        assert done["profile_dir"].startswith(svc.profile_root)
+        assert os.path.isdir(done["profile_dir"])
+        # ... and the artifact dir is persisted on the spool manifest
+        manifest = json.load(open(os.path.join(
+            svc.spool.root, f"{job['id']}.json")))
+        assert manifest["profile_dir"] == done["profile_dir"]
+        # executable analysis attached (bytes/FLOPs from XLA's static
+        # accounting).  It lands AFTER the job turns terminal by design
+        # (the analysis compile must never delay the dispatch), so poll
+        # the re-persisted manifest briefly.
+        deadline = time.time() + 60
+        while not done.get("exec_analysis") and time.time() < deadline:
+            time.sleep(0.2)
+            done = _http_json(svc, f"/jobs/{job['id']}")
+        assert done["exec_analysis"], "exec analysis missing from manifest"
+        assert done["exec_analysis"].get("bytes_accessed", 0) > 0 or \
+            done["exec_analysis"].get("temp_bytes", 0) > 0
+
+        listing = _http_json(svc, "/debug/profiles")
+        names = {p["name"] for p in listing["profiles"]}
+        assert os.path.basename(done["profile_dir"]) in names
+        assert os.path.basename(rec["dir"]) in names
+
+        # flight ring over HTTP: the job's whole path is there, no sink
+        fl = _http_json(svc, "/debug/flight")
+        assert fl["enabled"] is True
+        evs = [r["event"] for r in fl["events"]]
+        for needed in ("job_submitted", "admission", "dispatch", "job_done"):
+            assert needed in evs, (needed, set(evs))
+        # trace ids ride the flight records too
+        assert any(r.get("trace_id") == job["trace_id"]
+                   for r in fl["events"])
+
+        # /debug/memory + the memory gauges on /metrics
+        mem = _http_json(svc, "/debug/memory")
+        assert mem["host_rss_bytes"] > 0
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}/metrics", timeout=30)
+        samples = _parse_prometheus(resp.read().decode())
+        names = {n for n, _, _ in samples}
+        assert "ict_executable_bytes_accessed" in names
+    finally:
+        svc.stop()
+
+
+def test_flight_dump_on_injected_worker_fault(tmp_path, monkeypatch):
+    """Fault-ladder trip: a sharded dispatch that always throws degrades
+    the bucket to the oracle AND drops a flight dump next to the spool."""
+    flight.reset()
+    archive_path = str(tmp_path / "t.npz")
+    NpzIO().save(make_archive(nsub=8, nchan=16, nbin=64, seed=13),
+                 archive_path)
+    svc = _start_service(tmp_path, dispatch_retries=0)
+
+    def boom(entries):
+        raise RuntimeError("injected dispatch fault")
+
+    monkeypatch.setattr(svc.worker, "_dispatch_sharded", boom)
+    try:
+        job = _http_post(svc, "/jobs", {"path": archive_path})
+        assert svc.drain(120)
+        done = _http_json(svc, f"/jobs/{job['id']}")
+        assert done["state"] == "done"
+        assert done["served_by"] == "oracle-fallback"
+        dumps = os.listdir(svc.flight_dir)
+        assert dumps, "fault-ladder trip must dump the flight ring"
+        with open(os.path.join(svc.flight_dir, sorted(dumps)[-1])) as fh:
+            dump = json.load(fh)
+        assert "oracle_fallback" in dump["reason"]
+        assert any(r["event"] == "dispatch" for r in dump["events"])
+    finally:
+        svc.stop()
+
+
+# --- the perf gate ---
+
+
+def _load_perf_gate():
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate", os.path.join(REPO, "tools", "perf_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def perf_gate():
+    return _load_perf_gate()
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    with open(os.path.join(REPO, "docs", "bench_baseline_cpu.json")) as fh:
+        return json.load(fh)
+
+
+def test_perf_gate_passes_on_checked_in_baseline(perf_gate, baseline,
+                                                 tmp_path):
+    rc = perf_gate.main([
+        "--payload", os.path.join(REPO, "docs", "bench_baseline_cpu.json"),
+        "--history", str(tmp_path / "hist.jsonl")])
+    assert rc == 0
+    hist = [json.loads(ln) for ln in open(tmp_path / "hist.jsonl")]
+    assert hist and hist[0]["ok"] is True
+    assert hist[0]["static_bytes_cubes"]
+
+
+def test_perf_gate_fails_on_synthetic_regressions(perf_gate, baseline,
+                                                  tmp_path):
+    cases = {
+        "ratio": lambda p: p.update(
+            end_to_end_speedup_warm=baseline["end_to_end_speedup_warm"] / 10),
+        "static": lambda p: p["static_analysis"].update(
+            fused_bytes_cubes=baseline["static_analysis"]["fused_bytes_cubes"]
+            * 2),
+        "parity": lambda p: p.update(parity_small_config=False),
+        "error": lambda p: p.update(error="synthetic"),
+        "missing_memory": lambda p: p.pop("memory"),
+    }
+    for name, mutate in cases.items():
+        payload = copy.deepcopy(baseline)
+        mutate(payload)
+        path = tmp_path / f"{name}.json"
+        path.write_text(json.dumps(payload))
+        rc = perf_gate.main(["--payload", str(path), "--history", ""])
+        assert rc == 1, f"gate must fail on the {name} regression"
+
+
+def test_perf_gate_usage_errors(perf_gate):
+    assert perf_gate.main([]) == 2                      # no input
+    assert perf_gate.main(["--payload", "/nope.json",
+                           "--history", ""]) == 2      # unreadable payload
+
+
+def test_bench_headline_carries_memory_block():
+    import bench
+
+    payload = bench._headline({})
+    assert payload["memory"]["host_rss_bytes"] > 0
+
+
+# --- backend-init watchdog ---
+
+
+def test_init_watchdog_fires_and_stays_silent(capsys, monkeypatch):
+    from iterative_cleaner_tpu.utils import device_probe
+
+    flight.reset()
+    monkeypatch.setattr(device_probe, "_backend_liveness",
+                        lambda: "not_live")
+    before = tracing.snapshot("backend_init_watchdog")
+    with device_probe.init_watchdog("unit", timeout_s=0.2):
+        time.sleep(0.6)
+    time.sleep(0.1)
+    err = capsys.readouterr().err
+    assert "backend_init_watchdog" in err
+    rec = json.loads(err.split("warning: ", 1)[1].splitlines()[0])
+    assert rec["label"] == "unit"
+    assert tracing.delta(before, "backend_init_watchdog_fired") == 1
+    assert any(r["event"] == "backend_init_watchdog"
+               for r in flight.snapshot())
+    # a backend that comes up in time keeps it silent
+    monkeypatch.setattr(device_probe, "_backend_liveness", lambda: "live")
+    with device_probe.init_watchdog("unit2", timeout_s=0.2):
+        time.sleep(0.5)
+    assert "unit2" not in capsys.readouterr().err

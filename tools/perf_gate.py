@@ -1,0 +1,301 @@
+"""Perf-regression gate over bench.py's one-line JSON payload.
+
+BENCH_r01–r05 recorded the bench trajectory but nothing *read* them: a PR
+that quietly halved the warm speedup or grew the step's memory traffic
+would be discovered at r+5, by a human, on scarce hardware.  This gate
+closes the loop offline: CI (and anyone locally) runs the bench at a
+pinned small CPU config, compares the fresh payload against the
+checked-in baseline (``docs/bench_baseline_cpu.json``), and fails the
+build on a regression — then appends one line to the
+``docs/bench_history.jsonl`` trail either way, so the trajectory stays
+readable without archaeology.
+
+What is gated, and why it is non-flaky on shared CI runners:
+
+- **structure**: the payload contract itself — the driver keys, the
+  ``compile_accounting`` and ``memory`` blocks bench promises on every
+  exit path, and no top-level ``error``;
+- **parity booleans**: every ``parity_*`` flag in the payload must be
+  true — a mask-parity break IS the worst perf regression;
+- **speedup ratios** (``end_to_end_speedup_warm``,
+  ``per_iteration_speedup``): numpy and jax run on the *same* host in the
+  same process, so the ratio cancels machine speed; it must not fall
+  below baseline / ``--ratio-tolerance`` (default 3x — generous, catches
+  the order-of-magnitude regressions that matter);
+- **static memory traffic** (``static_analysis``: dense / incremental /
+  fused bytes-per-cube): XLA's own cost model, fully deterministic on a
+  pinned jax version, gated tight (``--static-tolerance``, default 1.15)
+  — a kernel change that re-reads the cube shows up here with zero noise;
+  and the incremental route must keep saving traffic over the dense one.
+
+Absolute wall-clock numbers are *recorded* in the history line but never
+gated: they measure the runner, not the code.
+
+Usage:
+  python tools/perf_gate.py --run                  # bench at the gate config, then compare
+  python tools/perf_gate.py --payload out.json     # compare an existing payload
+  python tools/perf_gate.py --run --save-baseline  # (re)pin the baseline
+
+Exit codes: 0 pass, 1 regression, 2 usage/environment error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO, "docs", "bench_baseline_cpu.json")
+DEFAULT_HISTORY = os.path.join(REPO, "docs", "bench_history.jsonl")
+
+#: The pinned gate config: small enough for a CI runner, big enough that
+#: the jax route genuinely iterates.  Changing it invalidates the baseline
+#: — regenerate with --save-baseline in the same commit.
+#:
+#: JAX_PLATFORMS=cpu + dropping PALLAS_AXON_POOL_IPS is the SAME pinning
+#: harness tests/test_bench_payload.py uses: the gate's contract is the
+#: deterministic CPU path, never TPU numbers (CLAUDE.md's "don't set
+#: JAX_PLATFORMS" applies to canonical bench artifacts, which remain a
+#: plain `python bench.py`).  Dropping the pool env is what keeps the dev
+#: environment's eager TPU-plugin sitecustomize — and therefore the
+#: wedged-tunnel hang — out of the child entirely.
+GATE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "BENCH_NSUB": "16",
+    "BENCH_NCHAN": "64",
+    "BENCH_NBIN": "128",
+    "BENCH_MAX_ITER": "3",
+    "BENCH_SKIP_NORTHSTAR": "1",
+    "BENCH_SKIP_PALLAS": "1",
+    "BENCH_SKIP_CHUNKED": "1",
+    "BENCH_SKIP_PHASES": "1",
+    "BENCH_MIRROR": "0",
+    "BENCH_WATCHDOG_S": "900",
+    "ICT_NO_COMPILE_CACHE": "1",
+}
+
+#: Ratio metrics (higher is better; machine speed cancels).
+RATIO_KEYS = ("end_to_end_speedup_warm", "per_iteration_speedup")
+
+#: Deterministic XLA cost-model keys under static_analysis (lower is
+#: better, in cube-sized units).
+STATIC_KEYS = ("step_dense_bytes_cubes", "step_incremental_bytes_cubes",
+               "fused_bytes_cubes")
+
+#: Blocks bench.py promises on every exit path since the obs layer landed.
+REQUIRED_KEYS = ("metric", "value", "unit", "vs_baseline",
+                 "compile_accounting", "memory")
+
+
+def run_gate_bench() -> dict:
+    """Run bench.py at the pinned gate config; returns its payload."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never reach for the TPU tunnel
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(GATE_ENV)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=1200, env=env, cwd=REPO)
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    if len(lines) != 1:
+        raise RuntimeError(
+            f"bench.py printed {len(lines)} stdout lines (contract: exactly "
+            f"one JSON line); stderr tail: {out.stderr[-1500:]}")
+    return json.loads(lines[0])
+
+
+def _walk_parity_flags(obj, prefix="") -> list[tuple[str, bool]]:
+    flags = []
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            key = f"{prefix}.{k}" if prefix else str(k)
+            if isinstance(v, bool) and str(k).startswith("parity"):
+                flags.append((key, v))
+            elif isinstance(v, dict):
+                flags.extend(_walk_parity_flags(v, key))
+    return flags
+
+
+def compare(payload: dict, baseline: dict, ratio_tolerance: float,
+            static_tolerance: float) -> list[str]:
+    """Returns the list of regressions (empty = gate passes)."""
+    problems: list[str] = []
+
+    if payload.get("error"):
+        problems.append(f"payload carries an error: {payload['error']!r}")
+    for key in REQUIRED_KEYS:
+        if key not in payload:
+            problems.append(f"payload missing required key {key!r}")
+    mem = payload.get("memory")
+    if isinstance(mem, dict) and not mem.get("host_rss_bytes"):
+        problems.append("memory block has no host_rss_bytes")
+
+    for key, ok in _walk_parity_flags(payload):
+        if not ok:
+            problems.append(f"parity flag {key} is False — masks diverged "
+                            "from the numpy oracle")
+
+    for key in RATIO_KEYS:
+        base = baseline.get(key)
+        fresh = payload.get(key)
+        if not isinstance(base, (int, float)) or base <= 0:
+            continue
+        if not isinstance(fresh, (int, float)):
+            problems.append(f"{key} missing from payload "
+                            f"(baseline has {base})")
+            continue
+        floor = base / ratio_tolerance
+        if fresh < floor:
+            problems.append(
+                f"{key} regressed: {fresh:.3g} < baseline {base:.3g} / "
+                f"tolerance {ratio_tolerance:g} (= {floor:.3g})")
+
+    sa_base = baseline.get("static_analysis") or {}
+    sa_fresh = payload.get("static_analysis") or {}
+    if isinstance(sa_base, dict) and isinstance(sa_fresh, dict):
+        for key in STATIC_KEYS:
+            base = sa_base.get(key)
+            fresh = sa_fresh.get(key)
+            if not isinstance(base, (int, float)) or base <= 0:
+                continue
+            if not isinstance(fresh, (int, float)):
+                problems.append(f"static_analysis.{key} missing from payload "
+                                f"(baseline has {base})")
+                continue
+            ceil = base * static_tolerance
+            if fresh > ceil:
+                problems.append(
+                    f"static_analysis.{key} regressed: {fresh:.4g} cube "
+                    f"passes > baseline {base:.4g} x {static_tolerance:g} "
+                    f"(= {ceil:.4g}) — the executable reads more memory")
+        if (isinstance(sa_base.get("incremental_saves_cubes"), (int, float))
+                and sa_base["incremental_saves_cubes"] > 0
+                and isinstance(sa_fresh.get("incremental_saves_cubes"),
+                               (int, float))
+                and sa_fresh["incremental_saves_cubes"] <= 0):
+            problems.append(
+                "incremental template no longer saves memory traffic over "
+                "the dense rebuild (incremental_saves_cubes <= 0)")
+    return problems
+
+
+def history_line(payload: dict, ok: bool) -> dict:
+    sa = payload.get("static_analysis") or {}
+    return {
+        "ts": round(time.time(), 3),
+        "ok": ok,
+        "device": payload.get("device"),
+        "jax_version": payload.get("jax_version"),
+        "metric": payload.get("metric"),
+        "value": payload.get("value"),
+        "end_to_end_speedup_warm": payload.get("end_to_end_speedup_warm"),
+        "per_iteration_speedup": payload.get("per_iteration_speedup"),
+        "jax_e2e_warm_s": payload.get("jax_e2e_warm_s"),
+        "numpy_e2e_s": payload.get("numpy_e2e_s"),
+        "static_bytes_cubes": {k: sa.get(k) for k in STATIC_KEYS
+                               if k in sa},
+        "host_rss_bytes": (payload.get("memory") or {}).get("host_rss_bytes"),
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="perf_gate",
+        description="compare a bench.py payload against the checked-in "
+                    "baseline; nonzero exit on regression")
+    p.add_argument("--payload", metavar="FILE",
+                   help="existing bench payload JSON ('-' = stdin)")
+    p.add_argument("--run", action="store_true",
+                   help="run bench.py at the pinned small CPU gate config")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE, metavar="FILE")
+    p.add_argument("--history", default=DEFAULT_HISTORY, metavar="FILE",
+                   help="JSONL trail appended on every gate run "
+                        "('' disables)")
+    p.add_argument("--out", default="", metavar="FILE",
+                   help="also write the fresh payload here (CI artifact)")
+    p.add_argument("--ratio-tolerance", type=float, default=3.0,
+                   help="speedup ratios may fall to baseline/N before "
+                        "failing (default 3)")
+    p.add_argument("--static-tolerance", type=float, default=1.15,
+                   help="static bytes-per-cube may grow by this factor "
+                        "before failing (default 1.15)")
+    p.add_argument("--save-baseline", action="store_true",
+                   help="write the fresh payload as the new baseline "
+                        "(exits 0 without comparing)")
+    args = p.parse_args(argv)
+
+    if bool(args.payload) == bool(args.run):
+        print("error: exactly one of --payload / --run is required",
+              file=sys.stderr)
+        return 2
+    if args.ratio_tolerance < 1 or args.static_tolerance < 1:
+        print("error: tolerances must be >= 1", file=sys.stderr)
+        return 2
+
+    try:
+        if args.run:
+            payload = run_gate_bench()
+        elif args.payload == "-":
+            payload = json.load(sys.stdin)
+        else:
+            with open(args.payload) as fh:
+                payload = json.load(fh)
+    except Exception as exc:  # noqa: BLE001 — one-line contract, rc 2
+        print(f"error: could not obtain a bench payload: {exc}",
+              file=sys.stderr)
+        return 2
+
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(payload, fh, indent=1)
+            fh.write("\n")
+
+    if args.save_baseline:
+        with open(args.baseline, "w") as fh:
+            json.dump(payload, fh, indent=1)
+            fh.write("\n")
+        print(json.dumps({"perf_gate": "baseline_saved",
+                          "baseline": args.baseline}))
+        return 0
+
+    try:
+        with open(args.baseline) as fh:
+            baseline = json.load(fh)
+    except Exception as exc:  # noqa: BLE001
+        print(f"error: could not read baseline {args.baseline!r}: {exc} "
+              "(generate one with --run --save-baseline)", file=sys.stderr)
+        return 2
+
+    problems = compare(payload, baseline,
+                       ratio_tolerance=args.ratio_tolerance,
+                       static_tolerance=args.static_tolerance)
+    ok = not problems
+
+    if args.history:
+        try:
+            with open(args.history, "a") as fh:
+                fh.write(json.dumps(history_line(payload, ok)) + "\n")
+        except OSError as exc:
+            print(f"warning: could not append history {args.history!r}: "
+                  f"{exc}", file=sys.stderr)
+
+    for msg in problems:
+        print(f"REGRESSION: {msg}", file=sys.stderr)
+    print(json.dumps({
+        "perf_gate": "ok" if ok else "FAIL",
+        "regressions": len(problems),
+        "metric": payload.get("metric"),
+        "value": payload.get("value"),
+        "end_to_end_speedup_warm": payload.get("end_to_end_speedup_warm"),
+        "baseline": os.path.relpath(args.baseline, REPO)
+        if args.baseline.startswith(REPO) else args.baseline,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
