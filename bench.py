@@ -151,6 +151,16 @@ def _headline(payload: dict) -> dict:
         payload.setdefault("memory", _obs_memory.memory_report())
     except Exception:  # noqa: BLE001 — the JSON line is the contract
         pass
+    try:
+        from iterative_cleaner_tpu.obs import audit as _obs_audit
+
+        # Shadow-oracle audit accounting (runs, divergences, drift beyond
+        # the documented bound) — pure counter reads, safe on every exit
+        # path; tools/perf_gate.py hard-fails on a nonzero divergence
+        # count here.
+        payload.setdefault("audit", _obs_audit.audit_report())
+    except Exception:  # noqa: BLE001 — the JSON line is the contract
+        pass
     value = payload.get("end_to_end_speedup", 0.0)
     shape = payload.get("config_a", {}).get("shape", [NSUB, NCHAN, NBIN])
     out = {
@@ -897,13 +907,33 @@ def run_bench() -> dict:
     t0 = time.time()
     Ds, w0s = preprocess(make_archive(nsub=64, nchan=256, nbin=512, seed=42))
     res_np = clean_cube(Ds, w0s, CleanConfig(backend="numpy", max_iter=5))
-    res_jx = clean_cube(
-        Ds, w0s, CleanConfig(backend="jax", max_iter=5, fused=True))
+    cfg_jx = CleanConfig(backend="jax", max_iter=5, fused=True)
+    res_jx = clean_cube(Ds, w0s, cfg_jx)
     _PAYLOAD["parity_small_config"] = bool(
         np.array_equal(res_np.weights, res_jx.weights))
     log(f"parity gate (64x256x512): identical="
         f"{_PAYLOAD['parity_small_config']} "
         f"loops={res_np.loops}/{res_jx.loops} [{time.time() - t0:.1f}s]")
+    try:
+        # The same comparison through the shadow-audit machinery
+        # (obs/audit): populates the audit_runs/divergences counters the
+        # top-level "audit" block reports on every exit path, and records
+        # the score ulp-drift next to the documented 5e-5 bound.  The
+        # already-computed oracle result is reused — no second replay.
+        from iterative_cleaner_tpu.obs import audit as _obs_audit
+
+        audit_rec, _w = _obs_audit.run_audit(
+            Ds, w0s, cfg_jx, res_jx.weights,
+            scores_served=res_jx.test_results, route="fused",
+            oracle_result=res_np)
+        _PAYLOAD["audit_small_config"] = audit_rec
+        log(f"[audit] mask_identical={audit_rec['mask_identical']} "
+            f"max_score_drift="
+            f"{audit_rec.get('max_score_drift', 0) or 0:.2e} "
+            f"(bound {_obs_audit.AUDIT_DRIFT_BOUND:g})")
+    except Exception as exc:  # noqa: BLE001 — the parity flag above gates
+        log(f"[audit] FAILED: {exc}")
+        _PAYLOAD["audit_small_config"] = {"error": str(exc)}
 
     # --- config A ---
     full_numpy = os.environ.get("BENCH_FULL_NUMPY", "1") != "0"

@@ -127,6 +127,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "incremental update saves one full cube read per "
                         "iteration after the first; masks are pinned "
                         "identical across both routes by the fuzz corpus)")
+    p.add_argument("--audit", action="store_true",
+                   help="shadow-oracle parity audit: after each archive is "
+                        "cleaned, replay it through the numpy oracle and "
+                        "compare flag masks bit-for-bit; a divergence prints "
+                        "loudly and writes a self-contained repro bundle "
+                        "(ICT_REPRO_DIR, default ./ict_repro) replayable "
+                        "with tools/replay_repro.py "
+                        "(docs/OBSERVABILITY.md)")
     p.add_argument("--dump_masks", action="store_true",
                    help="save the final mask (plus per-iteration history in "
                         "stepwise mode) as <output>_masks.npz")
@@ -180,6 +188,7 @@ def config_from_args(args: argparse.Namespace) -> CleanConfig:
         stream=args.stream,
         resume=args.resume,
         dump_masks=args.dump_masks,
+        audit=args.audit,
         trace_dir=args.trace,
     )
 

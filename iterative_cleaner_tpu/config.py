@@ -91,6 +91,12 @@ class CleanConfig:
     stream: bool = False           # sharded_batch: dispatch buckets as loads complete
     resume: bool = False           # skip archives whose cleaned output exists
     dump_masks: bool = False       # save mask history NPZ next to the output
+    audit: bool = False            # shadow-oracle parity audit: after each
+                                   # clean, replay the inputs through the
+                                   # numpy oracle and compare masks
+                                   # bit-for-bit (obs/audit.py; a mismatch
+                                   # writes a repro bundle); no-op on the
+                                   # numpy backend (it IS the oracle)
     trace_dir: str = ""            # jax.profiler trace output directory (the
                                    # one-shot CLI capture; the serving
                                    # daemon's bounded on-demand captures

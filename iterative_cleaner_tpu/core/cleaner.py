@@ -68,6 +68,16 @@ class CleanResult:
         # zapped entries are exactly 0.0).
         return float((self.weights == 0).mean())
 
+    def quality_summary(self) -> dict:
+        """RFI data-quality facts of this clean's mask (obs/quality.py):
+        zap fraction, per-channel/per-subint occupancy histograms,
+        fully-zapped counts, termination reason.  Pre-sweep weights — the
+        daemon computes the same summary on the final served mask."""
+        from iterative_cleaner_tpu.obs import quality
+
+        return quality.quality_summary(self.weights,
+                                       termination=self.termination)
+
 
 ProgressFn = Callable[[IterationInfo], None]
 

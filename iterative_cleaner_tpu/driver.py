@@ -58,6 +58,9 @@ class ArchiveReport:
     # dispatch and the sharded batch one per bucket, so both leave this
     # empty rather than reporting zeros).
     iteration_s: list[float] = field(default_factory=list)
+    # --audit: the shadow-oracle parity record (obs/audit.py), carried into
+    # the --report JSON; a divergence includes the repro-bundle path.
+    audit: dict | None = None
 
 
 def split_resumable(paths: list[str], cfg: CleanConfig):
@@ -231,7 +234,7 @@ def process_archive(
     if cfg.unload_res and out.residual is not None:
         io.save(out.residual, residual_name(path, res.loops))
 
-    return emit_outputs(
+    report = emit_outputs(
         io,
         archive,
         path,
@@ -250,6 +253,23 @@ def process_archive(
         iteration_s=[i.duration_s for i in res.iterations] if res.timed
         else None,
     )
+    if out.audit is not None:
+        report.audit = out.audit
+        if not out.audit.get("mask_identical", True):
+            # A parity break is never silenced (-q gates chatter only):
+            # the output was still written, but the operator must know the
+            # jax route disagreed with the executable spec.
+            print(f"AUDIT DIVERGENCE {path}: "
+                  f"{out.audit.get('n_mask_diffs')} mask bit(s) differ "
+                  f"from the numpy oracle"
+                  + (f"; repro bundle at {out.audit['bundle']}"
+                     if out.audit.get("bundle") else ""),
+                  file=sys.stderr)
+        elif not cfg.quiet and "skipped" not in out.audit:
+            print("Audit: mask identical to the numpy oracle "
+                  f"(max score drift "
+                  f"{out.audit.get('max_score_drift', 0) or 0:.2e})")
+    return report
 
 
 # Fraction of host RAM the all-at-once batch loader may plausibly fill

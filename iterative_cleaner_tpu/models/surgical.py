@@ -28,6 +28,9 @@ class SurgicalOutput:
     residual: Archive | None       # reference --unload_res payload
     n_bad_subints: int = 0
     n_bad_channels: int = 0
+    audit: dict | None = None      # --audit: the shadow-oracle parity
+                                   # record (obs/audit.run_audit; carries
+                                   # "bundle" on a divergence)
 
 
 def apply_output_policy(archive: Archive, weights: np.ndarray, cfg: CleanConfig) -> Archive:
@@ -97,10 +100,35 @@ class SurgicalCleaner:
                 dedispersed=archive.dedispersed,
             )
 
+        audit_rec = None
+        if cfg.audit and cfg.backend != "numpy":
+            # Shadow-oracle parity audit (obs/audit.py): replay the same
+            # preprocessed inputs through the numpy oracle and compare the
+            # FINAL mask (bad-parts sweep included on both sides).  A
+            # divergence writes a self-contained repro bundle; the audit
+            # never alters the outputs already computed above.
+            from iterative_cleaner_tpu.obs import audit as obs_audit
+
+            route = ("fused" if cfg.fused else
+                     "chunked" if cfg.chunk_block else "stepwise")
+            audit_rec, oracle_w = obs_audit.run_audit(
+                D, w0, cfg, final_w, scores_served=result.test_results,
+                route=route)
+            if not audit_rec["mask_identical"]:
+                audit_rec["bundle"] = obs_audit.write_repro_bundle(
+                    obs_audit.default_repro_dir(), D=D, w0=w0, cfg=cfg,
+                    reason=f"--audit divergence on the {route} route",
+                    weights_served=final_w, weights_oracle=oracle_w,
+                    scores_served=result.test_results, route=route,
+                    record=audit_rec)
+        elif cfg.audit:
+            audit_rec = {"skipped": "backend is the numpy oracle"}
+
         return SurgicalOutput(
             cleaned=cleaned,
             result=result,
             residual=residual,
             n_bad_subints=n_bs,
             n_bad_channels=n_bc,
+            audit=audit_rec,
         )

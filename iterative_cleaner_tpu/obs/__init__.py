@@ -23,7 +23,14 @@ shim over :mod:`.tracing`), organised as pillars:
                       from the ``trace_dir`` one-shot;
 - :mod:`.memory`    — HBM / host-RSS / compiled-executable memory+cost
                       accounting: every ``memory_stats()`` read in the
-                      tree, exported as gauges and JSON reports.
+                      tree, exported as gauges and JSON reports;
+- :mod:`.audit`     — shadow-oracle parity auditing (``ICT_AUDIT_RATE``,
+                      per-job opt-in), score ulp-drift accounting, and
+                      divergence repro bundles replayed by
+                      ``tools/replay_repro.py``;
+- :mod:`.quality`   — RFI data-quality telemetry: zap fractions,
+                      per-channel/per-subint occupancy histograms,
+                      per-diagnostic attribution rates, termination mix.
 
 Everything here is strictly read-only on the math: no hook ever touches a
 mask, and every hook is a no-op when its sink is disabled, so the hot path
@@ -32,14 +39,16 @@ flight recorder, and profiler capture on).
 """
 
 from iterative_cleaner_tpu.obs import (
+    audit,
     events,
     flight,
     forensics,
     memory,
     metrics,
     profiling,
+    quality,
     tracing,
 )
 
-__all__ = ["events", "flight", "forensics", "memory", "metrics",
-           "profiling", "tracing"]
+__all__ = ["audit", "events", "flight", "forensics", "memory", "metrics",
+           "profiling", "quality", "tracing"]

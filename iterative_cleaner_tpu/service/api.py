@@ -38,6 +38,10 @@ docs/OBSERVABILITY.md):
                             view of what fault-ladder/SIGTERM dumps write
 - ``GET  /debug/memory``    host RSS + per-device HBM view + recorded
                             executable analyses (obs/memory.py)
+- ``GET  /debug/audit``     shadow-oracle audit state (obs/audit.py):
+                            cumulative counters, sampling rate, queue
+                            depth, recent audit records, and the repro
+                            bundles on disk
 
 ThreadingHTTPServer: each request gets a thread, so a slow client cannot
 stall the poll loop; all handlers only touch thread-safe service surfaces
@@ -170,6 +174,17 @@ class _Handler(BaseHTTPRequestHandler):
             from iterative_cleaner_tpu.obs import memory as obs_memory
 
             self._reply(200, obs_memory.memory_report())
+        elif self.path == "/debug/audit":
+            from iterative_cleaner_tpu.obs import audit as obs_audit
+
+            report = obs_audit.audit_report()
+            report["rate"] = service.audit_rate()
+            report["queue_depth"] = (service.auditor.queue_depth()
+                                     if service.auditor else 0)
+            report["recent"] = (service.auditor.recent()
+                                if service.auditor else [])
+            report["bundles"] = obs_audit.list_bundles(service.repro_dir)
+            self._reply(200, report)
         elif self.path.startswith("/sessions/"):
             sid = self.path[len("/sessions/"):]
             self._session_call(lambda s: s.manifest(sid))
@@ -245,6 +260,7 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.loads(self._read_body(1 << 20) or b"{}")
             path = body["path"]
             profile = bool(body.get("profile", False))
+            audit = bool(body.get("audit", False))
         # TypeError covers valid-JSON non-dict bodies ('[]', '5', 'null'):
         # the client gets a 400, not a dropped socket.
         except (ValueError, KeyError, TypeError) as exc:
@@ -254,7 +270,7 @@ class _Handler(BaseHTTPRequestHandler):
         from iterative_cleaner_tpu.service.daemon import ServiceBusy
 
         try:
-            job = service.submit(str(path), profile=profile)
+            job = service.submit(str(path), profile=profile, audit=audit)
         except ServiceBusy as exc:
             self._reply(503, {"error": str(exc)}, headers={"Retry-After": "5"})
             return

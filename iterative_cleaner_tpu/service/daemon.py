@@ -73,6 +73,10 @@ class ServeConfig:
                                      # trust boundary)
     telemetry: str = ""              # JSON-lines event-log path (obs/events);
                                      # "" = honor ICT_TELEMETRY / disabled
+    audit_rate: float = -1.0         # shadow-oracle audit sampling fraction
+                                     # (obs/audit): < 0 = honor the
+                                     # ICT_AUDIT_RATE env (default 0); a
+                                     # per-job {"audit": true} always audits
     quiet: bool = False
     clean: CleanConfig = field(
         default_factory=lambda: CleanConfig(backend="jax"))
@@ -108,6 +112,11 @@ class CleaningService:
         # flight-recorder dumps (obs/flight — fault-ladder trips, SIGTERM).
         self.profile_root = os.path.join(serve_cfg.spool_dir, "profiles")
         self.flight_dir = os.path.join(serve_cfg.spool_dir, "flight")
+        # Divergence repro bundles (obs/audit): the shadow auditor writes
+        # one self-contained directory per confirmed mask mismatch here.
+        self.repro_dir = os.path.join(serve_cfg.spool_dir, "repro")
+        self.auditor = None
+        self._audit_divergences = 0
 
     # --- lifecycle ---
 
@@ -226,6 +235,20 @@ class CleaningService:
                 continue
             self._load_q.put(job)
             tracing.count("service_jobs_recovered")
+        # The shadow auditor always exists (a per-job {"audit": true} must
+        # work even at rate 0); idle it is one blocked queue.get.  Started
+        # HERE, after the trim/replay block above, because _audit_one
+        # writes spool manifests — the trim's .part sweep is only safe
+        # while no writer thread exists (the invariant jobs.trim
+        # documents).
+        from iterative_cleaner_tpu.obs.audit import ShadowAuditor
+
+        self.auditor = ShadowAuditor(
+            self.spool, self.repro_dir,
+            on_divergence=self.note_audit_divergence,
+            quiet=self.serve_cfg.quiet)
+        self.auditor.start()
+        self._threads.append(self.auditor)
         self.worker.start()
         self._threads.append(self.worker)
         for i in range(max(self.serve_cfg.loaders, 1)):
@@ -265,6 +288,8 @@ class CleaningService:
             self._load_q.put(_STOP)
         if self.worker is not None:
             self.worker.stop()
+        if self.auditor is not None:
+            self.auditor.stop()
         stuck = []
         for th in self._threads:
             th.join(timeout=10)
@@ -283,7 +308,8 @@ class CleaningService:
 
     # --- submission / inspection (the API's surface) ---
 
-    def submit(self, path: str, profile: bool = False) -> Job:
+    def submit(self, path: str, profile: bool = False,
+               audit: bool = False) -> Job:
         path = self._check_root(path)
         from iterative_cleaner_tpu.service.jobs import new_job_id
 
@@ -292,8 +318,11 @@ class CleaningService:
         # events) — echoed in the 202 response and the X-ICT-Trace header.
         # ``profile`` asks for a jax.profiler capture around this job's
         # dispatch (obs/profiling); the artifact dir lands on the manifest.
+        # ``audit`` asks for a shadow-oracle parity replay after it serves
+        # (obs/audit; ICT_AUDIT_RATE / --audit_rate samples the rest).
         job = Job(id=new_job_id(), path=path, submitted_s=time.time(),
-                  trace_id=events.new_trace_id(), profile=bool(profile))
+                  trace_id=events.new_trace_id(), profile=bool(profile),
+                  audit=bool(audit))
         # Cap check and insert under ONE lock hold: concurrent POST handler
         # threads must not all pass the check before any of them inserts
         # (the cap is the OOM backpressure — a race would breach it).
@@ -358,15 +387,28 @@ class CleaningService:
         with self._jobs_lock:
             self._jobs.pop(job.id, None)
 
+    def audit_rate(self) -> float:
+        """The effective shadow-audit sampling fraction: an explicit
+        --audit_rate wins; < 0 honors ICT_AUDIT_RATE (default 0)."""
+        from iterative_cleaner_tpu.obs import audit as obs_audit
+
+        if self.serve_cfg.audit_rate >= 0:
+            return min(self.serve_cfg.audit_rate, 1.0)
+        return obs_audit.audit_rate()
+
     def health(self) -> dict:
         """Liveness + the drain signals a load balancer needs: uptime,
         version, and every queue/spool depth (a degraded daemon shows up
-        as depths that only grow)."""
+        as depths that only grow).  The audit fields let a load balancer
+        gate on CORRECTNESS health, not just liveness: a daemon whose
+        audit_divergences moves is serving wrong masks."""
         from iterative_cleaner_tpu import __version__
+        from iterative_cleaner_tpu.obs import audit as obs_audit
 
         with self._jobs_lock:
             open_jobs = sum(1 for j in self._jobs.values()
                             if j.state not in TERMINAL)
+        audit_rep = obs_audit.audit_report()
         return {
             "status": "ok",
             "backend": self.backend_mode,
@@ -383,6 +425,9 @@ class CleaningService:
             "warm_shapes": (self.pool.warm_shapes_now() if self.pool else []),
             "open_sessions": (self.sessions.open_count()
                               if self.sessions else 0),
+            "audits_run": audit_rep["audits_run"],
+            "audit_divergences": audit_rep["divergences"],
+            "last_divergence_ts": audit_rep["last_divergence_ts"],
             "spool": self.spool.root,
         }
 
@@ -450,6 +495,29 @@ class CleaningService:
                   f"bucket dispatches failed (last: {exc}); demoting the "
                   "service to the numpy oracle backend", file=sys.stderr)
 
+    def note_audit_divergence(self, record: dict) -> None:
+        """The shadow auditor confirmed a served mask differed from the
+        oracle.  Repeated confirmed divergences demote the service the
+        same way repeated dispatch failures do (the worker ladder's top
+        rung): a route that keeps producing wrong masks is worse than a
+        route that keeps crashing."""
+        self._audit_divergences += 1
+        if (self.backend_mode == "jax"
+                and self._audit_divergences >= self.serve_cfg.demote_after):
+            self.backend_mode = "numpy"
+            tracing.count("service_backend_demotions")
+            flight.note("service_demoted_audit",
+                        n_divergences=self._audit_divergences,
+                        job_id=record.get("job_id", ""))
+            flight.dump(f"audit_divergence_demotion: "
+                        f"{self._audit_divergences} confirmed divergences "
+                        f"(last: job {record.get('job_id', '?')})",
+                        self.flight_dir)
+            print(f"ict-serve: {self._audit_divergences} confirmed audit "
+                  "divergences vs the numpy oracle; demoting the service "
+                  "to the oracle backend (repro bundles under "
+                  f"{self.repro_dir})", file=sys.stderr)
+
 
 # --- CLI ---
 
@@ -495,6 +563,15 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    metavar="NSUBxNCHANxNBIN",
                    help="shape class to precompile at startup (repeatable), "
                         "e.g. --warm 256x1024x1024")
+    p.add_argument("--audit_rate", type=float, default=-1.0, metavar="F",
+                   help="shadow-oracle audit sampling fraction in [0, 1]: "
+                        "this share of completed jobs is replayed through "
+                        "the numpy oracle on a background thread and the "
+                        "masks compared bit-for-bit (divergences write "
+                        "repro bundles under <spool>/repro and show on "
+                        "/healthz; docs/OBSERVABILITY.md).  Default: honor "
+                        "ICT_AUDIT_RATE (0 = off); a per-job "
+                        '{"audit": true} always audits')
     p.add_argument("--telemetry", default="", metavar="PATH",
                    help="append structured telemetry events (trace spans, "
                         "per-iteration forensics) to PATH as JSON lines "
@@ -540,6 +617,10 @@ def serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
                          f"extent), got {args.bucket_cap}")
     if args.alert_iters < 1:
         raise ValueError(f"--alert_iters must be >= 1, got {args.alert_iters}")
+    if args.audit_rate > 1:
+        raise ValueError(f"--audit_rate must be a fraction in [0, 1] "
+                         f"(negative = honor ICT_AUDIT_RATE), got "
+                         f"{args.audit_rate}")
     return ServeConfig(
         spool_dir=args.spool,
         host=args.host,
@@ -552,6 +633,7 @@ def serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
         alert_iters=args.alert_iters,
         root=args.root,
         telemetry=args.telemetry,
+        audit_rate=args.audit_rate,
         warm_shapes=parse_warm_shapes(args.warm),
         quiet=args.quiet,
         clean=CleanConfig(
@@ -592,8 +674,17 @@ def run_smoke(serve_cfg: ServeConfig) -> int:
         service.start()
         try:
             base = f"http://{cfg.host}:{service.port}"
+            # Every smoke run exercises the shadow-oracle audit end-to-end
+            # on top of the external mask check below — through the
+            # SAMPLING path when it is deterministic (rate exactly 1.0,
+            # the CI audit lane: genuinely covers the trigger the plain
+            # lane cannot), through the per-job opt-in otherwise (a
+            # FRACTIONAL rate would make the audits_run >= 1 requirement
+            # a coin flip on a healthy daemon).
+            want_flag = service.audit_rate() < 1.0
             req = urllib.request.Request(
-                f"{base}/jobs", data=json.dumps({"path": path}).encode(),
+                f"{base}/jobs",
+                data=json.dumps({"path": path, "audit": want_flag}).encode(),
                 headers={"Content-Type": "application/json"})
             job = json.load(urllib.request.urlopen(req, timeout=30))
             deadline = time.time() + 300
@@ -601,9 +692,14 @@ def run_smoke(serve_cfg: ServeConfig) -> int:
                 time.sleep(0.1)
                 job = json.load(urllib.request.urlopen(
                     f"{base}/jobs/{job['id']}", timeout=30))
+            # The audit runs on a background thread; /healthz must read
+            # its verdict, not its backlog.
+            service.auditor.drain(60)
             health = json.load(urllib.request.urlopen(
                 f"{base}/healthz", timeout=30))
             ok = job["state"] == "done" and health.get("status") == "ok"
+            audits_ok = (health.get("audits_run", 0) >= 1
+                         and health.get("audit_divergences", 0) == 0)
             masks_ok = False
             if ok:
                 from iterative_cleaner_tpu.parallel.batch import (
@@ -618,13 +714,15 @@ def run_smoke(serve_cfg: ServeConfig) -> int:
                 got = NpzIO().load(job["out_path"])
                 masks_ok = bool(np.array_equal(got.weights, want))
             print(json.dumps({
-                "smoke": "ok" if ok and masks_ok else "FAIL",
+                "smoke": "ok" if ok and masks_ok and audits_ok else "FAIL",
                 "job_state": job["state"],
                 "served_by": job.get("served_by", ""),
                 "mask_identical_to_oracle": masks_ok,
+                "audits_run": health.get("audits_run", 0),
+                "audit_divergences": health.get("audit_divergences", 0),
                 "backend": health.get("backend"),
             }))
-            return 0 if ok and masks_ok else 1
+            return 0 if ok and masks_ok and audits_ok else 1
         finally:
             service.stop()
 
@@ -656,29 +754,37 @@ def serve_main(argv: list[str] | None = None) -> int:
         # the operator contract is a one-line error + rc 1, not a traceback.
         print(f"error: {exc}", file=sys.stderr)
         return 1
-    # SIGTERM (the orchestrator's stop signal) dumps the flight ring before
-    # the graceful shutdown: "what was the daemon doing when it was killed"
-    # becomes a file in the spool instead of a guess.  Registered only for
-    # the real daemon run (not --smoke, not library embedders), and only
-    # from the main thread (signal.signal refuses elsewhere).
+    # SIGTERM (the orchestrator's stop signal) and SIGINT (a Ctrl-C'd dev
+    # daemon) both dump the flight ring before the graceful shutdown:
+    # "what was the daemon doing when it was killed" becomes a file in the
+    # spool instead of a guess — dev forensics matter as much as
+    # production ones.  Registered only for the real daemon run (not
+    # --smoke, not library embedders), and only from the main thread
+    # (signal.signal refuses elsewhere).
     import signal
 
-    def _on_sigterm(signum, frame):
-        path = flight.dump("SIGTERM", service.flight_dir)
-        print("ict-serve: SIGTERM — shutting down (unfinished jobs stay in "
+    def _on_stop_signal(signum, frame):
+        name = signal.Signals(signum).name
+        path = flight.dump(name, service.flight_dir)
+        print(f"ict-serve: {name} — shutting down (unfinished jobs stay in "
               f"the spool{'; flight ring at ' + path if path else ''})",
               file=sys.stderr)
         raise SystemExit(0)
 
-    try:
-        signal.signal(signal.SIGTERM, _on_sigterm)
-    except (ValueError, OSError):  # noqa: PERF203 — non-main-thread embed
-        pass
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _on_stop_signal)
+        except (ValueError, OSError):  # noqa: PERF203 — non-main-thread embed
+            pass
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
-        print("ict-serve: shutting down (unfinished jobs stay in the spool)",
+        # Reached only when the SIGINT handler could not be installed (a
+        # non-main-thread embed): same graceful stop, same flight dump.
+        path = flight.dump("KeyboardInterrupt", service.flight_dir)
+        print("ict-serve: shutting down (unfinished jobs stay in the spool"
+              f"{'; flight ring at ' + path if path else ''})",
               file=sys.stderr)
     finally:
         service.stop()

@@ -54,6 +54,16 @@ class Job:
                                     # capture around this job's dispatch
                                     # (obs/profiling.py)
     profile_dir: str = ""           # capture artifact directory, once taken
+    audit: bool = False             # submitter asked for a shadow-oracle
+                                    # parity audit of this job (obs/audit.py;
+                                    # ICT_AUDIT_RATE samples the rest)
+    # Shadow-audit outcome, re-persisted once the background replay
+    # finishes: mask_identical, n_mask_diffs, score drift vs the
+    # documented bound, and the repro-bundle path on a divergence.
+    audit_result: dict = field(default_factory=dict)
+    # RFI data-quality summary of the served mask (obs/quality.py): zap
+    # fraction, occupancy histograms, fully-zapped channel/subint counts.
+    quality: dict = field(default_factory=dict)
     # XLA's static accounting of the executable that served this job's
     # shape bucket (obs/memory.py: bytes accessed, FLOPs, buffer split) —
     # attached when exec analysis is enabled, persisted on the manifest.

@@ -22,6 +22,7 @@ failure ladder, top to bottom:
 
 from __future__ import annotations
 
+import dataclasses
 import queue
 import sys
 import threading
@@ -30,11 +31,13 @@ import time
 import numpy as np
 
 from iterative_cleaner_tpu.obs import (
+    audit as obs_audit,
     events,
     flight,
     forensics,
     memory as obs_memory,
     profiling,
+    quality as obs_quality,
     tracing,
 )
 from iterative_cleaner_tpu.service.jobs import TERMINAL, Job
@@ -175,7 +178,8 @@ class DispatchWorker(threading.Thread):
                            item.converged, item.rfi_frac, "sharded",
                            iterations=item.iterations,
                            termination=item.termination,
-                           emit_iteration_events=True)
+                           emit_iteration_events=True,
+                           scores=item.test_results)
             except Exception as exc:  # noqa: BLE001 — isolate the one job
                 self._fail(entries[i].job, f"output emission failed: {exc}")
             finally:
@@ -238,7 +242,8 @@ class DispatchWorker(threading.Thread):
                 final_w, rfi = finalize_weights(res.weights, cfg)
                 self._emit(e, final_w, res.loops, res.converged, rfi,
                            served_by, iterations=res.iterations,
-                           termination=res.termination)
+                           termination=res.termination,
+                           scores=res.test_results)
         except Exception as exc:  # noqa: BLE001 — isolate, report, continue
             self._fail(e.job, str(exc))
 
@@ -246,12 +251,14 @@ class DispatchWorker(threading.Thread):
 
     def _emit(self, e: Entry, weights, loops, converged, rfi_frac,
               served_by: str, iterations=None, termination: str = "",
-              emit_iteration_events: bool = False) -> None:
+              emit_iteration_events: bool = False, scores=None) -> None:
         """``iterations``/``termination`` land on the job manifest as the
         forensics timeline; ``emit_iteration_events`` additionally writes
         them to the event log (the batched route's post-hoc equivalent of
         the core loop's inline per-iteration events — the oracle route
-        already emitted inline under its trace scope, so it passes False)."""
+        already emitted inline under its trace scope, so it passes False).
+        ``scores`` is the route's last-iteration test scores, handed to the
+        shadow auditor for the ulp-drift check."""
         from iterative_cleaner_tpu.driver import atomic_save, output_name
         from iterative_cleaner_tpu.io.base import get_io
         from iterative_cleaner_tpu.models.surgical import apply_output_policy
@@ -273,9 +280,38 @@ class DispatchWorker(threading.Thread):
                 for rec in job.timeline:
                     events.emit("iteration", trace_id=job.trace_id,
                                 job_id=job.id, **rec)
-        job.state = "done"
+        # RFI data-quality telemetry (obs/quality.py): the served mask's
+        # zap fraction, occupancy histograms, and termination/attribution
+        # mix, on the manifest and as /metrics counters — a drifting
+        # receiver shows up as a metric anomaly, not a mystery.
+        job.quality = obs_quality.quality_summary(
+            np.asarray(weights), termination=termination)
+        obs_quality.record_job_quality(job.quality, timeline=job.timeline)
+        # Shadow-oracle audit (obs/audit.py): sampled (ICT_AUDIT_RATE) or
+        # per-job requested jobs are offered to the background auditor
+        # BEFORE the terminal transition below, so "every job is terminal"
+        # (drain) implies "every due audit is at least queued" — the drain
+        # + auditor.drain sequence the smoke check and tests rely on.  The
+        # queue keeps the cube arrays alive past the release below; a full
+        # queue skips, never blocks.  Jobs the oracle itself served are
+        # only audited on explicit request — a sampled replay of the
+        # oracle against the oracle proves nothing.
+        auditor = getattr(svc, "auditor", None)
+        if (auditor is not None
+                and (job.audit or served_by == "sharded")
+                and obs_audit.should_audit(job.audit, svc.audit_rate())):
+            auditor.submit(job, e.D, e.w0, np.asarray(weights), scores,
+                           served_by, svc.clean_cfg)
         job.finished_s = time.time()
-        svc.spool.save(job)
+        # Persist the done-stamped manifest BEFORE the in-memory state
+        # flips: drain() keys off ``job.state``, so flipping first opens a
+        # window where "every job is terminal" is true while the spool
+        # still says "running" — a reader (or a crash) in that window sees
+        # a served job without its quality/profile fields (observed as a
+        # test flake).  A copy carries the stamp; the shared field refs
+        # are only read for serialization.
+        svc.spool.save(dataclasses.replace(job, state="done"))
+        job.state = "done"
         svc.retire(job)
         tracing.count("service_jobs_done")
         tracing.count_labeled("jobs_served_total", {"route": served_by})

@@ -1,0 +1,365 @@
+"""Correctness observability (obs/audit.py + obs/quality.py): shadow-oracle
+parity audits, divergence repro bundles + tools/replay_repro.py, and RFI
+data-quality telemetry — including the acceptance path where an injected
+single-bit mask flip is caught by the daemon's background auditor, lands as
+a repro bundle, and replays end-to-end."""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube
+from iterative_cleaner_tpu.io.npz import NpzIO
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.obs import audit, metrics, quality, tracing
+from iterative_cleaner_tpu.ops.preprocess import preprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- RFI data-quality telemetry (obs/quality.py) ---
+
+
+def test_quality_summary_counts():
+    w = np.ones((4, 8), np.float32)
+    w[:, 0] = 0.0          # one fully-zapped channel
+    w[0, 1] = 0.0          # one stray zap
+    s = quality.quality_summary(w, termination="fixed_point")
+    assert s["n_profiles"] == 32 and s["n_zapped"] == 5
+    assert s["zap_frac"] == pytest.approx(5 / 32)
+    assert s["channels_fully_zapped"] == 1
+    assert s["subints_fully_zapped"] == 0
+    assert s["channel_occupancy_max"] == 1.0
+    assert s["termination"] == "fixed_point"
+    # cumulative fraction histograms end at the full population
+    assert s["channel_occupancy_hist"][-1] == 8
+    assert s["subint_occupancy_hist"][-1] == 4
+    assert s["channel_occupancy_hist"] == sorted(s["channel_occupancy_hist"])
+
+
+def test_cleanresult_quality_summary(small_archive):
+    D, w0 = preprocess(small_archive)
+    res = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=3))
+    s = res.quality_summary()
+    assert s["zap_frac"] == pytest.approx(res.rfi_frac)
+    assert s["termination"] == res.termination
+    assert len(s["channel_occupancy_hist"]) == len(quality.FRACTION_BOUNDS)
+
+
+def test_record_job_quality_metrics():
+    before = tracing.snapshot("rfi_zap_fraction")
+    w = np.ones((4, 8), np.float32)
+    w[:, 0] = 0.0
+    s = quality.quality_summary(w, termination="max_iter")
+    timeline = [{"index": 1, "zaps_by_diagnostic": {"std": 3, "fft": 1}}]
+    quality.record_job_quality(s, timeline=timeline)
+    assert tracing.delta(before, "rfi_zap_fraction_count") == 1
+    labeled = tracing.labeled_snapshot()
+    assert labeled[("jobs_terminated_total",
+                    (("reason", "max_iter"),))] >= 1
+    assert labeled[("rfi_zaps_attributed_total",
+                    (("diagnostic", "std"),))] >= 3
+    # and the Prometheus rendering carries the new families with labels
+    text = metrics.render_prometheus()
+    assert "ict_rfi_channel_occupancy_total{le=" in text
+    assert 'ict_jobs_terminated_total{reason="max_iter"}' in text
+
+
+# --- audit sampling knobs ---
+
+
+def test_audit_rate_env(monkeypatch):
+    monkeypatch.delenv("ICT_AUDIT_RATE", raising=False)
+    assert audit.audit_rate() == 0.0
+    monkeypatch.setenv("ICT_AUDIT_RATE", "0.25")
+    assert audit.audit_rate() == 0.25
+    monkeypatch.setenv("ICT_AUDIT_RATE", "7")      # clamped
+    assert audit.audit_rate() == 1.0
+    monkeypatch.setenv("ICT_AUDIT_RATE", "nope")   # unparseable -> default
+    assert audit.audit_rate() == 0.0
+    assert audit.should_audit(True, 0.0)           # per-job opt-in wins
+    assert audit.should_audit(False, 1.0)
+    assert not audit.should_audit(False, 0.0)
+
+
+def test_serve_audit_rate_validation(capsys):
+    from iterative_cleaner_tpu.service.daemon import serve_main
+
+    assert serve_main(["--audit_rate", "2.0"]) == 2
+    assert "--audit_rate" in capsys.readouterr().err
+
+
+# --- run_audit + repro bundles ---
+
+
+def test_run_audit_identical_within_bound(small_archive):
+    D, w0 = preprocess(small_archive)
+    cfg = CleanConfig(backend="jax", max_iter=4)
+    res = clean_cube(D, w0, cfg)
+    before = tracing.snapshot("audit")
+    rec, oracle_w = audit.run_audit(D, w0, cfg, res.weights,
+                                    scores_served=res.test_results,
+                                    route="stepwise")
+    assert rec["mask_identical"] and rec["n_mask_diffs"] == 0
+    # the incremental-template default's documented score envelope
+    assert rec["drift_within_bound"]
+    assert rec["max_score_drift"] <= audit.AUDIT_DRIFT_BOUND
+    np.testing.assert_array_equal(oracle_w, res.weights)
+    assert tracing.delta(before, "audit_runs") == 1
+    assert tracing.delta(before, "audit_divergences") == 0
+
+
+def test_run_audit_divergence_bundle_and_replay(small_archive, tmp_path):
+    """A single flipped mask bit is a divergence: counted, bundled, and the
+    bundle replays end-to-end through tools/replay_repro.py (which clears
+    the live route — the flip was injected, not in the code)."""
+    D, w0 = preprocess(small_archive)
+    cfg = CleanConfig(backend="jax", max_iter=4)
+    res = clean_cube(D, w0, cfg)
+    flipped = res.weights.copy()
+    i, j = np.argwhere(flipped != 0)[0]
+    flipped[i, j] = 0.0
+    before = tracing.snapshot("audit")
+    rec, oracle_w = audit.run_audit(D, w0, cfg, flipped,
+                                    scores_served=res.test_results,
+                                    route="stepwise")
+    assert not rec["mask_identical"]
+    assert rec["n_mask_diffs"] == 1
+    assert rec["mask_diff_coords"] == [[int(i), int(j)]]
+    assert tracing.delta(before, "audit_divergences") == 1
+    gauges, _ = tracing.gauges_snapshot()
+    assert gauges["audit_last_divergence_ts"] > 0
+
+    bundle = audit.write_repro_bundle(
+        str(tmp_path / "repro"), D=D, w0=w0, cfg=cfg,
+        reason="unit-test injected flip", weights_served=flipped,
+        weights_oracle=oracle_w, record=rec, route="stepwise")
+    assert bundle and os.path.isdir(bundle)
+    for name in ("manifest.json", "arrays.npz", "flight.json"):
+        assert os.path.exists(os.path.join(bundle, name))
+    manifest, arrays = audit.load_repro_bundle(bundle)
+    assert manifest["versions"]["iterative_cleaner_tpu"]
+    assert manifest["record"]["n_mask_diffs"] == 1
+    np.testing.assert_array_equal(arrays["D"], D)
+    assert audit.config_from_manifest(manifest) == cfg
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO})
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "replay_repro.py"),
+         bundle],
+        capture_output=True, text=True, timeout=600, env=env)
+    verdict = json.loads(out.stdout.strip().splitlines()[-1])
+    assert verdict["recorded_mask_matches_oracle"] is False
+    assert verdict["n_recorded_diffs"] == 1
+    assert verdict["live_mask_identical"] is True, out.stderr[-1500:]
+    assert verdict["repro"] == "cleared"
+    assert out.returncode == 0
+
+
+def test_bundle_sweep_keeps_bounded(tmp_path, monkeypatch):
+    monkeypatch.setattr(audit, "MAX_BUNDLES_KEPT", 3)
+    D = np.zeros((2, 3, 8), np.float32)
+    w0 = np.ones((2, 3), np.float32)
+    cfg = CleanConfig()
+    for _ in range(5):
+        assert audit.write_repro_bundle(str(tmp_path), D=D, w0=w0, cfg=cfg,
+                                        reason="sweep test")
+    names = [n for n in os.listdir(tmp_path) if n.startswith("repro-")]
+    assert len(names) == 3
+
+
+# --- parity pin: audit machinery on, masks stay the oracle's ---
+
+
+@pytest.mark.parametrize("seed", [50, 51])
+def test_masks_bit_identical_with_audit_on_fuzzed(seed, monkeypatch,
+                                                  tmp_path):
+    """Fuzz spot seeds with the audit path active end-to-end (the
+    SurgicalCleaner --audit route): masks bit-identical to the oracle,
+    score drift inside the documented envelope, on the stepwise and fused
+    routes."""
+    from test_fuzz_equivalence import draw_case
+
+    from iterative_cleaner_tpu.models.surgical import SurgicalCleaner
+
+    monkeypatch.setenv("ICT_REPRO_DIR", str(tmp_path / "repro"))
+    archive, kw = draw_case(seed)
+    res_np = clean_cube(*preprocess(archive),
+                        CleanConfig(backend="numpy", **kw))
+    for name, cfg in (
+        ("stepwise", CleanConfig(backend="jax", audit=True, **kw)),
+        ("fused", CleanConfig(backend="jax", fused=True, audit=True, **kw)),
+    ):
+        out = SurgicalCleaner(cfg).clean(archive)
+        np.testing.assert_array_equal(
+            out.cleaned.weights, res_np.weights, err_msg=name)
+        assert out.audit is not None, name
+        assert out.audit["mask_identical"], name
+        assert out.audit["drift_within_bound"], name
+    assert not (tmp_path / "repro").exists()  # no divergence, no bundles
+
+
+def test_cli_audit_report(tmp_path, monkeypatch):
+    from iterative_cleaner_tpu.cli import main
+
+    monkeypatch.chdir(tmp_path)
+    path = str(tmp_path / "a.npz")
+    NpzIO().save(make_archive(nsub=6, nchan=16, nbin=64, seed=7), path)
+    rc = main(["--backend", "jax", "-q", "-l", "--audit",
+               "--report", "rep.json", path])
+    assert rc == 0
+    rep = json.load(open(tmp_path / "rep.json"))
+    assert rep[0]["audit"]["mask_identical"] is True
+    assert rep[0]["audit"]["drift_within_bound"] is True
+
+
+# --- the daemon acceptance path: injected bit flip -> audit -> bundle ---
+
+
+def _start_service(tmp_path, **kw):
+    import jax
+
+    from iterative_cleaner_tpu.parallel.mesh import make_mesh
+    from iterative_cleaner_tpu.service import CleaningService, ServeConfig
+
+    mesh = make_mesh(8, devices=jax.devices("cpu"))
+    defaults = dict(spool_dir=str(tmp_path / "spool"), port=0,
+                    deadline_s=0.2, quiet=True,
+                    clean=CleanConfig(backend="jax", max_iter=3, quiet=True,
+                                      no_log=True))
+    defaults.update(kw)
+    svc = CleaningService(ServeConfig(**defaults), mesh=mesh)
+    svc.start()
+    return svc
+
+
+def _http_json(svc, route):
+    return json.load(urllib.request.urlopen(
+        f"http://127.0.0.1:{svc.port}{route}", timeout=30))
+
+
+def test_daemon_audit_catches_injected_bit_flip(tmp_path, monkeypatch):
+    """The divergence path end-to-end: a jax route monkeypatched to flip
+    one mask bit is caught by the shadow audit, a repro bundle appears,
+    ict_audit_divergences_total increments, /healthz + /debug/audit report
+    it, the service demotes to the oracle (demote_after=1), and
+    tools/replay_repro.py reproduces the recorded mismatch (and clears the
+    live route — the flip lives in this process's monkeypatch, not in the
+    code)."""
+    import iterative_cleaner_tpu.parallel.batch as batch_mod
+
+    real = batch_mod.sharded_clean
+
+    def flipping(Db, w0b, cfg, mesh, want_history=False):
+        out = real(Db, w0b, cfg, mesh, want_history=want_history)
+        w_b = np.array(out[1])
+        i, j = np.argwhere(w_b[0] != 0)[0]
+        w_b[0, i, j] = 0.0
+        return (out[0], w_b, *out[2:])
+
+    monkeypatch.setattr(batch_mod, "sharded_clean", flipping)
+    archive_path = str(tmp_path / "t.npz")
+    NpzIO().save(make_archive(nsub=8, nchan=16, nbin=64, seed=13),
+                 archive_path)
+    before = tracing.snapshot("audit")
+    svc = _start_service(tmp_path, demote_after=1)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc.port}/jobs",
+            data=json.dumps({"path": archive_path, "audit": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        job = json.load(urllib.request.urlopen(req, timeout=30))
+        assert svc.drain(120)
+        assert svc.auditor.drain(120)
+
+        j = _http_json(svc, f"/jobs/{job['id']}")
+        assert j["state"] == "done" and j["served_by"] == "sharded"
+        assert j["audit_result"]["mask_identical"] is False
+        assert j["audit_result"]["n_mask_diffs"] == 1
+        bundle = j["audit_result"]["bundle"]
+        assert bundle and os.path.isdir(bundle)
+        assert bundle.startswith(svc.repro_dir)
+        # quality telemetry rode along on the same manifest
+        assert j["quality"]["zap_frac"] > 0
+        assert j["quality"]["channel_occupancy_hist"][-1] == 16
+
+        assert tracing.delta(before, "audit_divergences") == 1
+        health = _http_json(svc, "/healthz")
+        assert health["audits_run"] >= 1
+        assert health["audit_divergences"] >= 1
+        assert health["last_divergence_ts"] > 0
+
+        dbg = _http_json(svc, "/debug/audit")
+        assert dbg["divergences"] >= 1
+        assert any(b["path"] == bundle for b in dbg["bundles"])
+        assert any(r.get("job_id") == job["id"] and not r["mask_identical"]
+                   for r in dbg["recent"])
+
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}/metrics", timeout=30).read().decode()
+        assert "ict_audit_divergences" in text
+        assert 'ict_audit_drift_total{le=' in text
+
+        # one confirmed divergence (demote_after=1) demoted the service
+        assert svc.backend_mode == "numpy"
+    finally:
+        svc.stop()
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO})
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "replay_repro.py"),
+         bundle],
+        capture_output=True, text=True, timeout=600, env=env)
+    verdict = json.loads(out.stdout.strip().splitlines()[-1])
+    assert verdict["recorded_mask_matches_oracle"] is False
+    assert verdict["n_recorded_diffs"] == 1
+    assert verdict["live_mask_identical"] is True, out.stderr[-1500:]
+    assert verdict["repro"] == "cleared" and out.returncode == 0
+
+
+def test_daemon_audit_rate_samples_sharded_jobs(tmp_path, monkeypatch):
+    """ICT_AUDIT_RATE=1.0: every sharded job is audited without a per-job
+    flag, masks agree with the oracle (the audit-enabled smoke lane's
+    in-suite pin), and the audit result lands on the manifest."""
+    monkeypatch.setenv("ICT_AUDIT_RATE", "1.0")
+    paths = []
+    for k in range(2):
+        p = str(tmp_path / f"r{k}.npz")
+        NpzIO().save(make_archive(nsub=8, nchan=16, nbin=64, seed=20 + k), p)
+        paths.append(p)
+    before = tracing.snapshot("audit")
+    svc = _start_service(tmp_path)
+    try:
+        jobs = []
+        for p in paths:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{svc.port}/jobs",
+                data=json.dumps({"path": p}).encode(),
+                headers={"Content-Type": "application/json"})
+            jobs.append(json.load(urllib.request.urlopen(req, timeout=30)))
+        assert svc.drain(120)
+        assert svc.auditor.drain(120)
+        assert tracing.delta(before, "audit_runs") == 2
+        assert tracing.delta(before, "audit_divergences") == 0
+        for job in jobs:
+            j = _http_json(svc, f"/jobs/{job['id']}")
+            assert j["audit_result"]["mask_identical"] is True
+            assert j["audit_result"]["drift_within_bound"] is True
+        # Counters are process-cumulative (earlier tests injected a real
+        # divergence); this run must not have moved the needle.
+        health = _http_json(svc, "/healthz")
+        assert health["audit_divergences"] == before.get(
+            "audit_divergences", 0)
+    finally:
+        svc.stop()
+    assert not os.path.isdir(svc.repro_dir)  # no divergence, no bundles

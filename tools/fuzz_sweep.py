@@ -70,6 +70,7 @@ def main() -> int:
         block = int(rng.integers(1, D.shape[0] + 1))
         x64 = bool(jax.config.jax_enable_x64)
         modes = {}
+        mode_cfgs = {}
         for name, cfg in (
             # stepwise/fused/chunked run the r04 incremental-template
             # default; each dense rebuild stays fuzzed via its own mode
@@ -93,6 +94,7 @@ def main() -> int:
         ):
             r = clean_cube(D, w0, cfg)
             modes[name] = (r.weights, r.loops, r.converged)
+            mode_cfgs[name] = cfg
 
         # The streaming-ingest route: seed-random block splits, bounded
         # provisional passes, then the canonical finalize — whose mask must
@@ -100,11 +102,13 @@ def main() -> int:
         # advisory by contract and not compared).
         r_on = run_online_case(archive, kw, seed, x64=x64)
         modes["online"] = (r_on.weights, r_on.loops, r_on.converged)
+        mode_cfgs["online"] = CleanConfig(backend="jax", x64=x64, **kw)
 
         if not x64:  # the sharded path deliberately declines x64
             _t, w_sh, loops_sh, done_sh = sharded_clean_single(
                 D, w0, CleanConfig(backend="jax", **kw), mesh)
             modes["sharded"] = (w_sh, loops_sh, done_sh)
+            mode_cfgs["sharded"] = CleanConfig(backend="jax", **kw)
 
         bad = [name for name, (w, loops, conv) in modes.items()
                if not (np.array_equal(w, res_np.weights)
@@ -113,6 +117,22 @@ def main() -> int:
         status = "FAIL " + ",".join(bad) if bad else "ok"
         if bad:
             failures.append((seed, bad))
+            # Every mode/oracle mismatch is captured as a self-contained
+            # repro bundle (obs/audit) — the failing seed alone reproduces
+            # it too, but the bundle travels to machines without this
+            # generator and feeds tools/replay_repro.py directly.
+            from iterative_cleaner_tpu.obs import audit as obs_audit
+
+            for name in bad:
+                bundle = obs_audit.write_repro_bundle(
+                    obs_audit.default_repro_dir(),
+                    D=D, w0=w0, cfg=mode_cfgs[name],
+                    reason=f"fuzz_sweep seed {seed} mode {name}: mask/loop "
+                           f"mismatch vs the numpy oracle",
+                    weights_served=np.asarray(modes[name][0]),
+                    weights_oracle=res_np.weights, route=name)
+                print(f"  seed {seed} mode {name}: repro bundle at "
+                      f"{bundle or 'WRITE FAILED'}", flush=True)
         print(f"seed {seed}: cube {D.shape} max_iter={kw['max_iter']} "
               f"loops={res_np.loops} zap={(res_np.weights == 0).sum()} "
               f"{status}", flush=True)

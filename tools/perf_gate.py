@@ -88,7 +88,7 @@ STATIC_KEYS = ("step_dense_bytes_cubes", "step_incremental_bytes_cubes",
 
 #: Blocks bench.py promises on every exit path since the obs layer landed.
 REQUIRED_KEYS = ("metric", "value", "unit", "vs_baseline",
-                 "compile_accounting", "memory")
+                 "compile_accounting", "memory", "audit")
 
 
 def run_gate_bench() -> dict:
@@ -138,6 +138,24 @@ def compare(payload: dict, baseline: dict, ratio_tolerance: float,
         if not ok:
             problems.append(f"parity flag {key} is False — masks diverged "
                             "from the numpy oracle")
+
+    # Any shadow-audit divergence in the payload is a hard failure: like a
+    # False parity flag, it means a served mask differed from the oracle —
+    # the one regression no tolerance covers.
+    audit = payload.get("audit")
+    if isinstance(audit, dict):
+        if audit.get("divergences"):
+            problems.append(
+                f"audit block reports {audit['divergences']} shadow-oracle "
+                "mask divergence(s) — masks diverged from the numpy oracle")
+        if audit.get("drift_exceeded"):
+            problems.append(
+                f"audit block reports {audit['drift_exceeded']} score-drift "
+                "excursion(s) beyond the documented 5e-5 envelope")
+    rec = payload.get("audit_small_config")
+    if isinstance(rec, dict) and rec.get("mask_identical") is False:
+        problems.append("audit_small_config.mask_identical is False — the "
+                        "benched fused route diverged from the oracle")
 
     for key in RATIO_KEYS:
         base = baseline.get(key)

@@ -1,0 +1,144 @@
+"""Replay a divergence repro bundle against both backends (offline).
+
+A bundle (written by ``obs/audit.write_repro_bundle`` — the daemon's
+shadow auditor, the CLI's ``--audit``, or ``tools/fuzz_sweep.py``) holds
+everything a mask divergence needs to travel: the preprocessed input cube
+and weights, the exact CleanConfig, versions, trace context, and the
+flight ring at capture time.  This tool re-executes it:
+
+1. the **numpy oracle** on the bundle's inputs (the executable spec);
+2. the **recorded jax route** (the bundle's own CleanConfig) — a live
+   rerun, so a divergence caused by the code CONFIRMS and one caused by
+   transient corruption (or an injected fault in the capturing process)
+   CLEARS;
+3. the **recorded served mask**, when the bundle carries one, against the
+   fresh oracle — whether the original incident itself reproduces from
+   the recorded artifacts.
+
+Prints one JSON line:
+
+    {"repro": "confirmed" | "cleared", "live_mask_identical": ...,
+     "recorded_mask_matches_oracle": ..., ...}
+
+Exit codes: 0 = replay ran and the live route agrees with the oracle
+(cleared), 1 = the live route still diverges (confirmed), 2 = unusable
+bundle / usage error.
+
+Usage: python tools/replay_repro.py <bundle_dir>
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Same offline pinning as tools/fuzz_sweep.py: the dev environment exports
+# JAX_PLATFORMS=axon and a wedged tunnel hangs any axon init.  The virtual
+# 8-device platform lets a sharded-route bundle replay on the kernel that
+# actually diverged, not just the stepwise stand-in.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+
+def replay(bundle_dir: str) -> dict:
+    """Re-execute one bundle; returns the verdict payload (raises on an
+    unreadable bundle — main turns that into rc 2)."""
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from iterative_cleaner_tpu.core.cleaner import clean_cube
+    from iterative_cleaner_tpu.obs import audit
+    from iterative_cleaner_tpu.parallel.batch import finalize_weights
+
+    manifest, arrays = audit.load_repro_bundle(bundle_dir)
+    cfg = audit.config_from_manifest(manifest)
+    D, w0 = arrays["D"], arrays["w0"]
+
+    cfg_np = audit.oracle_config(cfg)
+    res_np = clean_cube(D, w0, cfg_np)
+    oracle_w, _ = finalize_weights(res_np.weights, cfg_np)
+
+    out = {
+        "bundle": bundle_dir,
+        "reason": manifest.get("reason", ""),
+        "route": manifest.get("route", ""),
+        "trace_id": manifest.get("trace_id", ""),
+        "cube_shape": list(D.shape),
+        "oracle_loops": int(res_np.loops),
+        "captured_versions": manifest.get("versions", {}),
+    }
+
+    # The recorded incident: does the mask the original process SERVED
+    # still differ from a fresh oracle run?  (None when the bundle was
+    # written without one.)
+    served = arrays.get("weights_served")
+    if served is not None:
+        n = int(np.sum(served != oracle_w))
+        out["recorded_mask_matches_oracle"] = n == 0
+        out["n_recorded_diffs"] = n
+    else:
+        out["recorded_mask_matches_oracle"] = None
+
+    # The live question: does the recorded route, re-run on this tree and
+    # this machine, still diverge?  The in-process route (stepwise / fused
+    # / chunked — the bundle's own CleanConfig carries those flags) runs
+    # through clean_cube; a sharded-route bundle ADDITIONALLY replays the
+    # sharded kernel on the virtual 8-device mesh, because "the sharded
+    # route diverges while stepwise agrees" is exactly the class of bug a
+    # route-tagged bundle exists to pin down.
+    live_cfg = (cfg if cfg.backend == "jax"
+                else cfg.replace(backend="jax")).replace(audit=False)
+    res_live = clean_cube(D, w0, live_cfg)
+    live_w, _ = finalize_weights(res_live.weights, live_cfg)
+    live_diffs = {"clean_cube": int(np.sum(live_w != oracle_w))}
+    out["live_loops"] = int(res_live.loops)
+    if "sharded" in str(manifest.get("route", "")):
+        from iterative_cleaner_tpu.parallel.mesh import make_mesh
+        from iterative_cleaner_tpu.parallel.sharded import (
+            sharded_clean_single,
+        )
+
+        mesh = make_mesh(8, devices=jax.devices("cpu"))
+        _t, w_sh, _loops, _done = sharded_clean_single(D, w0, live_cfg, mesh)
+        w_sh, _ = finalize_weights(np.asarray(w_sh), live_cfg)
+        live_diffs["sharded"] = int(np.sum(w_sh != oracle_w))
+    n_live = max(live_diffs.values())
+    out["live_mask_identical"] = n_live == 0
+    out["n_live_diffs"] = n_live
+    out["live_diffs_by_route"] = live_diffs
+    out["repro"] = "cleared" if n_live == 0 else "confirmed"
+    if served is not None and n_live == 0 and int(out["n_recorded_diffs"]):
+        out["note"] = ("the recorded served mask differs from the oracle "
+                       "but a live rerun does not: the divergence was "
+                       "transient in the capturing process (or injected), "
+                       "not reproducible from the inputs")
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+        print(__doc__, file=sys.stderr)
+        return 2
+    try:
+        out = replay(argv[0])
+    except Exception as exc:  # noqa: BLE001 — one-line contract, rc 2
+        print(json.dumps({"repro": "error",
+                          "error": f"{type(exc).__name__}: {exc}",
+                          "bundle": argv[0]}))
+        return 2
+    print(json.dumps(out))
+    return 1 if out["repro"] == "confirmed" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
