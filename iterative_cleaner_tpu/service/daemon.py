@@ -92,14 +92,21 @@ class CleaningService:
         self.spool = JobSpool(serve_cfg.spool_dir)
         self.mesh = mesh
         self.started_s = time.time()   # re-stamped at start(); /healthz uptime
-        self.backend_mode = self.clean_cfg.backend   # "jax" | "numpy"
+        # Demotion state ("jax" | "numpy") is written by three threads
+        # (startup, the dispatch worker's note_dispatch_failure, the shadow
+        # auditor's note_audit_divergence) and read everywhere: one lock
+        # makes the count-then-demote transition atomic, so two racing
+        # failure reports can neither lose an increment nor double-fire
+        # the demotion side effects (flight dump, stderr line).
+        self._mode_lock = threading.Lock()
+        self.backend_mode = self.clean_cfg.backend  # ict: guarded-by(self._mode_lock)
         self.bucket_cap = 1
         self.port = serve_cfg.port
         self.pool = None
-        self._jobs: dict[str, Job] = {}
+        self._jobs: dict[str, Job] = {}  # ict: guarded-by(self._jobs_lock)
         self._jobs_lock = threading.Lock()
         self._load_q: queue.Queue = queue.Queue()
-        self._consecutive_failures = 0
+        self._consecutive_failures = 0  # ict: guarded-by(self._mode_lock)
         self._threads: list[threading.Thread] = []
         self._stop_evt = threading.Event()
         self._server = None
@@ -116,7 +123,7 @@ class CleaningService:
         # one self-contained directory per confirmed mask mismatch here.
         self.repro_dir = os.path.join(serve_cfg.spool_dir, "repro")
         self.auditor = None
-        self._audit_divergences = 0
+        self._audit_divergences = 0  # ict: guarded-by(self._mode_lock)
 
     # --- lifecycle ---
 
@@ -167,21 +174,18 @@ class CleaningService:
                 print("ict-serve: backend liveness indeterminable after a "
                       "hung probe; serving via the numpy oracle",
                       file=sys.stderr)
-                self.backend_mode = "numpy"
+                with self._mode_lock:
+                    self.backend_mode = "numpy"
         cap = 1
         if self.backend_mode == "jax":
             if self.mesh is None:
                 from iterative_cleaner_tpu.parallel.mesh import make_mesh
-                from iterative_cleaner_tpu.utils.device_probe import (
-                    init_watchdog,
-                )
 
-                # make_mesh is this daemon's first in-process jax.devices():
-                # the init watchdog turns a wedged-tunnel freeze HERE into a
-                # structured warning (ICT_INIT_TIMEOUT_S) instead of a
-                # silent never-came-up.
-                with init_watchdog("ict-serve backend init"):
-                    self.mesh = make_mesh()
+                # make_mesh is this daemon's first in-process device read;
+                # its internal init_watchdog turns a wedged-tunnel freeze
+                # into a structured warning (ICT_INIT_TIMEOUT_S) instead
+                # of a silent never-came-up.
+                self.mesh = make_mesh()
             cap = self.serve_cfg.bucket_cap or max(int(self.mesh.shape["dp"]), 1)
         self.scheduler = ShapeBucketScheduler(
             cap, self.serve_cfg.deadline_s, self._on_flush)
@@ -478,20 +482,28 @@ class CleaningService:
         self.worker.submit(entries)
 
     def note_dispatch_ok(self) -> None:
-        self._consecutive_failures = 0
+        with self._mode_lock:
+            self._consecutive_failures = 0
 
     def note_dispatch_failure(self, exc) -> None:
-        self._consecutive_failures += 1
-        if (self.backend_mode == "jax"
-                and self._consecutive_failures >= self.serve_cfg.demote_after):
-            self.backend_mode = "numpy"
+        # Count-then-demote under the mode lock (the worker and auditor
+        # threads both reach the demotion transition); side effects fire
+        # outside it, exactly once, on the thread that flipped the mode.
+        with self._mode_lock:
+            self._consecutive_failures += 1
+            n_failures = self._consecutive_failures
+            demote = (self.backend_mode == "jax"
+                      and n_failures >= self.serve_cfg.demote_after)
+            if demote:
+                self.backend_mode = "numpy"
+        if demote:
             tracing.count("service_backend_demotions")
             # The top rung of the fault ladder: dump the flight ring — the
             # post-mortem of what led to a service-wide demotion is worth a
             # file even when nobody configured telemetry.
             flight.note("service_demoted", error=str(exc))
             flight.dump(f"service_demotion: {exc}", self.flight_dir)
-            print(f"ict-serve: {self._consecutive_failures} consecutive "
+            print(f"ict-serve: {n_failures} consecutive "
                   f"bucket dispatches failed (last: {exc}); demoting the "
                   "service to the numpy oracle backend", file=sys.stderr)
 
@@ -501,19 +513,23 @@ class CleaningService:
         same way repeated dispatch failures do (the worker ladder's top
         rung): a route that keeps producing wrong masks is worse than a
         route that keeps crashing."""
-        self._audit_divergences += 1
-        if (self.backend_mode == "jax"
-                and self._audit_divergences >= self.serve_cfg.demote_after):
-            self.backend_mode = "numpy"
+        with self._mode_lock:
+            self._audit_divergences += 1
+            n_div = self._audit_divergences
+            demote = (self.backend_mode == "jax"
+                      and n_div >= self.serve_cfg.demote_after)
+            if demote:
+                self.backend_mode = "numpy"
+        if demote:
             tracing.count("service_backend_demotions")
             flight.note("service_demoted_audit",
-                        n_divergences=self._audit_divergences,
+                        n_divergences=n_div,
                         job_id=record.get("job_id", ""))
             flight.dump(f"audit_divergence_demotion: "
-                        f"{self._audit_divergences} confirmed divergences "
+                        f"{n_div} confirmed divergences "
                         f"(last: job {record.get('job_id', '?')})",
                         self.flight_dir)
-            print(f"ict-serve: {self._audit_divergences} confirmed audit "
+            print(f"ict-serve: {n_div} confirmed audit "
                   "divergences vs the numpy oracle; demoting the service "
                   "to the oracle backend (repro bundles under "
                   f"{self.repro_dir})", file=sys.stderr)
