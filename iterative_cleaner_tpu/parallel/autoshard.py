@@ -42,11 +42,17 @@ def default_devices():
     the router runs inside one process's control flow, so a DCN-spanning
     mesh here would dispatch collectives the other processes never join
     (multihost.py promises the router 'never picks DCN spontaneously')."""
-    dev = jax.config.jax_default_device
-    if dev is not None:
-        return jax.local_devices(
-            backend=dev if isinstance(dev, str) else dev.platform)
-    return jax.local_devices()
+    from iterative_cleaner_tpu.utils.device_probe import init_watchdog
+
+    # A library embedder's clean_cube() reaches this before any CLI-layer
+    # probe ran: first backend init can happen HERE, and a wedged tunnel
+    # hangs it process-wide — the watchdog makes that diagnosable.
+    with init_watchdog("autoshard device discovery"):
+        dev = jax.config.jax_default_device
+        if dev is not None:
+            return jax.local_devices(
+                backend=dev if isinstance(dev, str) else dev.platform)
+        return jax.local_devices()
 
 
 def device_memory_bytes(device=None) -> int | None:

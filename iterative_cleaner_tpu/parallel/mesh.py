@@ -59,7 +59,15 @@ def make_mesh(
     DCN-spanning mesh for a single replicated program.
     """
     if devices is None:
-        devices = jax.local_devices()
+        from iterative_cleaner_tpu.utils.device_probe import init_watchdog
+
+        # This is the first in-process device read for every caller that
+        # does not bring its own devices (batch dispatch, tools) — the
+        # wedged-tunnel hang lands exactly here, so the watchdog turns a
+        # silent freeze into a structured warning (the daemon's own wrap
+        # in _start_locked is now one of several guarded paths).
+        with init_watchdog("make_mesh device discovery"):
+            devices = jax.local_devices()
     if n_devices is None:
         n_devices = len(devices)
     devices = devices[:n_devices]

@@ -55,7 +55,12 @@ def main() -> int:
 
     enable_persistent_cache()
 
-    dev = jax.devices()[0]
+    from iterative_cleaner_tpu.utils.device_probe import init_watchdog
+
+    # First backend init of this probe process: the watchdog turns a
+    # wedged-tunnel freeze into a structured warning (bench.py's recipe).
+    with init_watchdog("probe_template_perf device init"):
+        dev = jax.devices()[0]
     print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
 
     rng = np.random.default_rng(0)
