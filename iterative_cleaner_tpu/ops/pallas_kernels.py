@@ -125,7 +125,7 @@ def fused_fit_moments(D, template, w0, *, pulse_region=(0.0, 0.0, 1.0),
     if pulse_region_active(pulse_region):
         bin_scale = pulse_region_bin_scale(nbin, pulse_region)
     else:
-        bin_scale = np.ones(nbin, dtype=np.float32)
+        bin_scale = jnp.ones(nbin, dtype=jnp.float32)
 
     # Pad every dim to tile multiples; padded profiles/bins are zero and are
     # sliced away below (per-profile math — no cross-contamination).
@@ -180,7 +180,9 @@ def _platform() -> str:
     Device or a platform string (both supported by JAX)."""
     dev = jax.config.jax_default_device
     if dev is None:
-        return jax.default_backend()
+        # Dispatch-time read: the caller is about to run a kernel on this
+        # very backend, so init happens on this thread either way.
+        return jax.default_backend()  # ict: backend-init-ok(dispatch-time; compute follows on this thread)
     return dev if isinstance(dev, str) else dev.platform
 
 
