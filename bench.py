@@ -524,7 +524,8 @@ def _bench_pallas(state) -> dict:
     nbin = D.shape[-1]
     if use_interpret() or not pallas_route_ok(nbin):
         return {"skipped": f"pallas route not viable here "
-                           f"(platform={jax.default_backend()}, nbin={nbin})"}
+                           f"(platform={jax.default_backend()}, "  # ict: backend-init-ok(after _init_device)
+                           f"nbin={nbin})"}
     kw = dict(max_iter=MAX_ITER, pulse_region=(0.0, 0.0, 1.0),
               use_pallas=True)
     t0 = time.time()
@@ -609,7 +610,7 @@ def _bench_static_analysis() -> dict:
     except Exception:  # noqa: BLE001 — the section's own keys still land
         pass
     res = {
-        "backend": jax.default_backend(),
+        "backend": jax.default_backend(),  # ict: backend-init-ok(after _init_device)
         "shape": list(shape),
         "step_dense_bytes_cubes": dense,
         "step_incremental_bytes_cubes": incr,

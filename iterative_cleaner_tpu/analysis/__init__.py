@@ -1,0 +1,29 @@
+"""Invariant-aware static analysis for the iterative_cleaner_tpu tree.
+
+Three layers, one finding vocabulary (docs/ANALYSIS.md):
+
+- :mod:`.rules` + :mod:`.bench_cfg` — AST source lint over the project's
+  load-bearing conventions (guarded backend init, mask-path determinism
+  and dtype discipline, the bench.py JSON-on-every-exit contract, the
+  Prometheus metric grammar, no numpy inside jit traces);
+- :mod:`.races` — a static race detector for the threaded ``service/`` and
+  ``obs/`` packages: module-global and lock-owning-class shared state must
+  carry ``# ict: guarded-by(<lock>)`` annotations, annotated writes must
+  happen under their lock, and the lock-acquisition graph must be
+  cycle-free (lock-order inversions);
+- :mod:`.contracts` — a jaxpr/HLO contract checker that traces each
+  registered clean route (stepwise, fused, chunked, sharded) on a tiny
+  cube and asserts no host callbacks, the expected dtype lattice (the jax
+  side of the oracle's f64-promotion split stays uniformly 32-bit), and
+  that the declared buffer-donation count survived lowering.
+
+``tools/ict_lint.py`` is the CLI; findings are suppressible only through
+the checked-in ``tools/ict_lint_baseline.json``.
+"""
+
+from iterative_cleaner_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    collect_project_files,
+    load_baseline,
+    parse_annotations,
+)

@@ -1,0 +1,171 @@
+"""ICT004/bench-exit: bench.py prints its one JSON line on EVERY exit path.
+
+The contract (CLAUDE.md, pinned at runtime by tests/test_bench_payload.py
+and content-checked by tools/perf_gate.py's payload-contract blocks — this
+rule is the *static* half of that pair): every way the bench process can
+terminate must be dominated by a call to ``_emit`` (the one function that
+prints the payload line and mirrors it into docs/).
+
+The check is a small dominance walk over bench.py's statement-level CFG.
+Python blocks are linear statement lists, so "X dominates exit E" reduces
+to: walking outward from E through its enclosing blocks (stopping at the
+owning function boundary — an emit in an *enclosing def* happened at a
+different time, not on this path), some statement strictly before E's
+position **always emits**.  A statement always-emits when it is an
+``_emit(...)`` call, an ``if`` whose branches BOTH always-emit, a ``with``
+whose body does, or a ``try`` whose body and every handler do.  This is
+conservative: a path that emits only conditionally does not count.
+
+Checked exits: every ``return`` in ``main``, and every ``os._exit`` /
+``sys.exit`` / ``raise SystemExit`` anywhere in the file — except the
+module-level ``sys.exit(main())`` trampoline, whose payload emission is
+``main``'s own obligation (already checked).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from iterative_cleaner_tpu.analysis.engine import Finding, SourceFile
+from iterative_cleaner_tpu.analysis.rules import dotted_name
+
+EMIT_FN = "_emit"
+#: The function whose returns are process exits (rc for sys.exit).
+MAIN_FN = "main"
+
+
+def _is_emit_stmt(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        name = dotted_name(stmt.value.func) or ""
+        return name.split(".")[-1] == EMIT_FN
+    return False
+
+
+def _always_emits(stmt: ast.stmt) -> bool:
+    if _is_emit_stmt(stmt):
+        return True
+    if isinstance(stmt, ast.If):
+        return (bool(stmt.orelse)
+                and _block_emits(stmt.body) and _block_emits(stmt.orelse))
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return _block_emits(stmt.body)
+    if isinstance(stmt, ast.Try):
+        return (_block_emits(stmt.body)
+                and all(_block_emits(h.body) for h in stmt.handlers))
+    return False
+
+
+def _block_emits(stmts: list[ast.stmt]) -> bool:
+    return any(_always_emits(s) for s in stmts)
+
+
+def _exit_dominated(path: list[tuple[list[ast.stmt], int]]) -> bool:
+    """``path`` is the chain of (enclosing statement list, index of the
+    statement on the way to the exit) from the owning function's body down
+    to the exit statement itself."""
+    for stmts, idx in reversed(path):
+        if _block_emits(stmts[:idx]):
+            return True
+    return False
+
+
+def _walk_exits(fn_body: list[ast.stmt]):
+    """Yield (exit_node, kind, chain) for every exit statement under this
+    function body, NOT descending into nested function defs (their exits
+    are their own paths — walked separately)."""
+
+    def visit(stmts: list[ast.stmt], chain):
+        for idx, stmt in enumerate(stmts):
+            here = chain + [(stmts, idx)]
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested defs run on their own paths
+            if isinstance(stmt, ast.Return):
+                yield stmt, "return", here
+                continue
+            if isinstance(stmt, ast.Raise) and stmt.exc is not None:
+                name = None
+                if isinstance(stmt.exc, ast.Call):
+                    name = dotted_name(stmt.exc.func)
+                elif isinstance(stmt.exc, ast.Name):
+                    name = stmt.exc.id
+                if name == "SystemExit":
+                    yield stmt, "raise SystemExit", here
+            sub_blocks = [getattr(stmt, f, None)
+                          for f in ("body", "orelse", "finalbody")]
+            handlers = getattr(stmt, "handlers", None)
+            cases = getattr(stmt, "cases", None)   # match statements
+            if any(sub_blocks) or handlers or cases:
+                for sub in sub_blocks:
+                    if sub:
+                        yield from visit(sub, here)
+                for handler in handlers or ():
+                    yield from visit(handler.body, here)
+                for case in cases or ():
+                    yield from visit(case.body, here)
+            else:
+                for call in _exit_calls(stmt):
+                    yield call, dotted_name(call.func), here
+
+    yield from visit(fn_body, [])
+
+
+def _exit_calls(stmt: ast.stmt):
+    """os._exit / sys.exit calls inside a simple (non-compound) statement."""
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            if name in ("os._exit", "sys.exit", "exit", "quit"):
+                yield node
+
+
+def rule_bench_exit(sf: SourceFile) -> list[Finding]:
+    # Exactly the repo-root bench.py: that file alone carries the one-line
+    # JSON payload contract (a future tools/microbench.py owes nothing).
+    if sf.path != "bench.py" or sf.tree is None:
+        return []
+    out: list[Finding] = []
+
+    fns = {n.name: n for n in ast.walk(sf.tree)
+           if isinstance(n, ast.FunctionDef)}
+    if EMIT_FN not in fns or MAIN_FN not in fns:
+        out.append(sf.finding(
+            "ICT004/bench-exit", 1,
+            f"bench.py must define '{EMIT_FN}' (the one-line JSON print) "
+            f"and '{MAIN_FN}' — the exit-path contract has no anchor "
+            f"without them"))
+        return out
+
+    # Every function body is walked for hard exits (os._exit can hide in a
+    # watchdog thread); 'return' exits are an obligation of main only.
+    for fn in fns.values():
+        if fn.name in (EMIT_FN,):
+            continue  # the emitter itself is the dominator, not a client
+        for node, kind, chain in _walk_exits(fn.body):
+            if kind == "return" and fn.name != MAIN_FN:
+                continue
+            if _exit_dominated(chain):
+                continue
+            out.append(sf.finding(
+                "ICT004/bench-exit", node.lineno,
+                f"exit path ({kind} in '{fn.name}') is not dominated by "
+                f"an {EMIT_FN}() call: bench.py must print its one-line "
+                f"JSON payload on EVERY exit path (CLAUDE.md; runtime "
+                f"half: tools/perf_gate.py payload-contract checks)"))
+
+    # Module-level exits: only the sys.exit(main()) trampoline is allowed.
+    for stmt in sf.tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue  # function-level exits were walked above
+        for node in _exit_calls(stmt):
+            args = node.args
+            if (dotted_name(node.func) == "sys.exit" and len(args) == 1
+                    and isinstance(args[0], ast.Call)
+                    and dotted_name(args[0].func) == MAIN_FN):
+                continue
+            out.append(sf.finding(
+                "ICT004/bench-exit", node.lineno,
+                "module-level hard exit bypasses main()'s emit-dominated "
+                "paths; only 'sys.exit(main())' is allowed"))
+    return out

@@ -1,0 +1,289 @@
+"""Compiled-artifact contracts: jaxpr/HLO checks on the four clean routes.
+
+Source lint catches what a human wrote; this layer checks what XLA is
+actually going to run.  Each registered route — stepwise, fused, chunked,
+sharded — is traced on a tiny cube (abstract avals: no device buffers, no
+real compile beyond lowering) and three contracts are asserted:
+
+- **no host callbacks** — a ``pure_callback``/``io_callback``/debug
+  primitive inside a route would punch a host round-trip into the hot
+  loop (and deadlock under the daemon's one-device-owner threading
+  model); the jaxpr must not contain one, at any nesting depth.
+- **dtype lattice** — the oracle's numpy.ma pipeline promotes 3 of the 4
+  diagnostics to f64 *on the host*; the jax route's side of the parity
+  contract is that it stays uniformly 32-bit (SURVEY §8.L9) — any f64 /
+  complex128 aval in a traced route means someone mixed the two worlds
+  and the masks will drift.  The trace runs with ``jax_enable_x64``
+  temporarily ON: with it off, jax silently demotes every 64-bit request
+  at trace time and the check could never fire.  Only *strong* 64-bit
+  avals are forbidden — under x64 every Python scalar literal passes
+  through as a weak f64 that immediately converts back to f32, which is
+  exactly the demotion behavior the f32 route relies on, while a real
+  ``astype(float64)`` / ``np.float64`` constant is strong and is caught.
+  (``--x64`` routes are the operator's explicit opt-in and not traced.)
+- **donation ledger** — buffer-donation annotations silently vanish when
+  a wrapper re-jits or an alias is dropped at lowering; the lowered
+  StableHLO's donation markers must match :data:`ROUTE_DONATIONS`
+  exactly.  Today every route declares 0 (donation is a planned ingest
+  optimisation, ROADMAP item 2); landing one means updating the ledger
+  in the same PR — that is the contract doing its job.
+
+Run via ``tools/ict_lint.py --contracts`` (CI: ``JAX_PLATFORMS=cpu``).
+Imports jax lazily so the source/race layers stay import-light; callers
+must pin the platform *before* this module traces (the CLI does — the
+CLAUDE.md wedged-tunnel quirk).
+"""
+
+from __future__ import annotations
+
+from iterative_cleaner_tpu.analysis.engine import Finding
+
+#: Tiny trace shape: nbin >= 3 (the parity floor), everything else minimal
+#: but structurally representative (nsub/nchan big enough for the robust
+#: scalers' medians to be nondegenerate).
+TINY_SHAPE = (4, 8, 64)
+TINY_BATCH = 2
+TINY_MAX_ITER = 3
+
+#: route -> expected donation-marker count in the lowered module.  A PR
+#: that adds jax donation (e.g. donate_argnums on an ingest path) must
+#: bump its route here — the checker fails on any mismatch, in BOTH
+#: directions (a vanished donation is a silent perf regression; an
+#: unexpected one is a correctness hazard for callers that reuse inputs).
+ROUTE_DONATIONS = {
+    "stepwise": 0,
+    "fused": 0,
+    "chunked": 0,
+    "sharded": 0,
+}
+
+#: Substrings of primitive names that mean "host round-trip".
+CALLBACK_MARKERS = ("callback", "outside_call", "infeed", "outfeed")
+
+#: 64-bit avals forbidden on the f32 parity routes.
+FORBIDDEN_DTYPES = ("float64", "complex128")
+
+#: StableHLO attribute names jax uses to mark donated/aliased inputs.
+DONATION_MARKERS = ("tf.aliasing_output", "jax.buffer_donor")
+
+
+def _finding(route: str, label: str, kind: str, message: str) -> Finding:
+    # ``kind`` (callback / dtype / donation / ...) goes into the snippet —
+    # the fingerprint basis — so baselining one violation class for a
+    # route can never suppress a *different* future violation at the same
+    # route/label.
+    return Finding(rule="ICT009/route-contract",
+                   path="iterative_cleaner_tpu/analysis/contracts.py",
+                   line=1, snippet=f"{route}:{label}:{kind}",
+                   message=f"[{route}/{label}] {message}")
+
+
+def _walk_jaxpr(jaxpr, seen: set) -> list:
+    """Every eqn of a (closed) jaxpr, recursing through sub-jaxprs in eqn
+    params (pjit / while / cond / scan bodies)."""
+    core = getattr(jaxpr, "jaxpr", jaxpr)
+    if id(core) in seen:
+        return []
+    seen.add(id(core))
+    eqns = []
+    for eqn in core.eqns:
+        eqns.append(eqn)
+        for val in eqn.params.values():
+            for sub in _iter_jaxprs(val):
+                eqns.extend(_walk_jaxpr(sub, seen))
+    return eqns
+
+
+def _iter_jaxprs(val):
+    # Type-name matching, not isinstance: the public home of Jaxpr /
+    # ClosedJaxpr has moved across jax versions (jax.core -> jax.extend)
+    # and this must not chase it.
+    if type(val).__name__ in ("Jaxpr", "ClosedJaxpr"):
+        yield val
+    elif isinstance(val, (list, tuple)):
+        for item in val:
+            yield from _iter_jaxprs(item)
+
+
+def _check_jaxpr(route: str, label: str, closed) -> list[Finding]:
+    out: list[Finding] = []
+    eqns = _walk_jaxpr(closed, set())
+    for eqn in eqns:
+        prim = eqn.primitive.name
+        if any(marker in prim for marker in CALLBACK_MARKERS):
+            out.append(_finding(
+                route, label, "callback",
+                f"host-callback primitive '{prim}' in the traced route: "
+                f"the hot loop must stay device-only"))
+    bad: set[str] = set()
+    for eqn in eqns:
+        for var in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(var, "aval", None)
+            dtype = getattr(aval, "dtype", None)
+            if (dtype is not None and str(dtype) in FORBIDDEN_DTYPES
+                    # Weak 64-bit scalars are Python literals mid-demotion
+                    # (module docstring); only strong avals are real leaks.
+                    and not getattr(aval, "weak_type", False)):
+                bad.add(f"{prim_name(eqn)}:{dtype}")
+    if bad:
+        out.append(_finding(
+            route, label, "dtype",
+            f"64-bit avals in the f32 parity route ({sorted(bad)[:4]}): "
+            f"the jax side of the oracle's f64-promotion split must stay "
+            f"uniformly 32-bit (SURVEY §8.L9)"))
+    return out
+
+
+def prim_name(eqn) -> str:
+    return getattr(eqn.primitive, "name", "?")
+
+
+def _count_donations(lowered) -> int:
+    text = lowered.as_text()
+    return sum(text.count(marker) for marker in DONATION_MARKERS)
+
+
+def _route_lowerings():
+    """(route, label, lowered, closed_jaxpr) for every registered route's
+    jit entry points, traced on the tiny shape.  Every entry point a route
+    dispatches is covered — the chunked route is four small kernels, not
+    one."""
+    import jax
+    import jax.numpy as jnp  # noqa: F401 — backend init before tracing
+    import numpy as np
+
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        advance_template,
+        clean_step,
+        fused_clean,
+        step_from_template,
+    )
+    from iterative_cleaner_tpu.parallel.chunked import (
+        _block_stats,
+        _finish,
+        _partial_template,
+        _sparse_template_update,
+    )
+    from iterative_cleaner_tpu.parallel.sharded import batched_fused_clean
+
+    nsub, nchan, nbin = TINY_SHAPE
+    f32, b1 = np.float32, np.bool_
+    D = jax.ShapeDtypeStruct((nsub, nchan, nbin), f32)
+    w = jax.ShapeDtypeStruct((nsub, nchan), f32)
+    v = jax.ShapeDtypeStruct((nsub, nchan), b1)
+    t = jax.ShapeDtypeStruct((nbin,), f32)
+    s = jax.ShapeDtypeStruct((), f32)
+    pr = (0.0, 0.0, 1.0)
+
+    from iterative_cleaner_tpu.backends.jax_backend import (
+        INCREMENTAL_TEMPLATE_BUDGET,
+    )
+
+    budget = min(INCREMENTAL_TEMPLATE_BUDGET, nsub * nchan)
+    dvals = jax.ShapeDtypeStruct((budget,), f32)
+    profs = jax.ShapeDtypeStruct((budget, nbin), f32)
+    Db = jax.ShapeDtypeStruct((TINY_BATCH, nsub, nchan, nbin), f32)
+    wb = jax.ShapeDtypeStruct((TINY_BATCH, nsub, nchan), f32)
+    vb = jax.ShapeDtypeStruct((TINY_BATCH, nsub, nchan), b1)
+    nstat = jax.ShapeDtypeStruct((nsub, nchan), f32)
+
+    entries = [
+        # The stepwise route: dense step, incremental step + the sparse
+        # template advance it carries between iterations.
+        ("stepwise", "clean_step", clean_step,
+         (D, w, v, w, s, s), {"pulse_region": pr, "use_pallas": False}),
+        ("stepwise", "step_from_template", step_from_template,
+         (D, w, v, t, s, s), {"pulse_region": pr, "use_pallas": False}),
+        ("stepwise", "advance_template", advance_template,
+         (D, t, w, w), {}),
+        # The fused route (the CLI/daemon default: incremental template).
+        ("fused", "fused_clean", fused_clean, (D, w, v, s, s),
+         {"max_iter": TINY_MAX_ITER, "pulse_region": pr,
+          "want_residual": False, "use_pallas": False, "incremental": True}),
+        # The chunked (>HBM streaming) route's four kernels.
+        ("chunked", "partial_template", _partial_template, (D, w), {}),
+        ("chunked", "block_stats", _block_stats, (D, t, w, v),
+         {"pulse_region": pr, "want_resid": False}),
+        ("chunked", "sparse_template_update", _sparse_template_update,
+         (t, dvals, profs), {}),
+        ("chunked", "finish", _finish,
+         (nstat, nstat, nstat, nstat, v, w, s, s), {}),
+        # The sharded batch route (vmapped fused loop; shardings are
+        # call-time input properties, the traced computation is this).
+        ("sharded", "batched_fused_clean", batched_fused_clean,
+         (Db, wb, vb, s, s),
+         {"max_iter": TINY_MAX_ITER, "pulse_region": pr}),
+    ]
+    for route, label, fn, args, kwargs in entries:
+        lowered = fn.lower(*args, **kwargs)
+        # The jaxpr view for primitive/dtype checks: trace the same jit
+        # callable (make_jaxpr sees through pjit into the full program).
+        closed = jax.make_jaxpr(
+            lambda *a, _fn=fn, _kw=kwargs: _fn(*a, **_kw))(*args)
+        yield route, label, lowered, closed
+
+
+def check_routes() -> list[Finding]:
+    """All contracts on all routes; an un-traceable route is itself a
+    finding (the checker must never silently skip a route)."""
+    import jax
+
+    findings: list[Finding] = []
+    seen_routes: set[str] = set()
+    # x64 ON for the trace (restored after): with it off, jax demotes
+    # every 64-bit request at trace time and the dtype contract would be
+    # vacuously green — see the module docstring.
+    x64_before = bool(jax.config.jax_enable_x64)
+    try:
+        jax.config.update("jax_enable_x64", True)
+        lowerings = list(_route_lowerings())
+    except Exception as exc:  # noqa: BLE001 — surfaced as a finding
+        return [_finding("all", "trace", "trace-failure",
+                         f"route tracing failed: {type(exc).__name__}: "
+                         f"{exc}")]
+    finally:
+        jax.config.update("jax_enable_x64", x64_before)
+    donations: dict[str, int] = {}
+    for route, label, lowered, closed in lowerings:
+        seen_routes.add(route)
+        findings.extend(_check_jaxpr(route, label, closed))
+        donations[route] = donations.get(route, 0) + _count_donations(lowered)
+    for route, expected in sorted(ROUTE_DONATIONS.items()):
+        if route not in seen_routes:
+            findings.append(_finding(
+                route, "coverage", "untraced",
+                "route registered in ROUTE_DONATIONS but not traced — "
+                "add its entry points to _route_lowerings()"))
+            continue
+        got = donations.get(route, 0)
+        if got != expected:
+            findings.append(_finding(
+                route, "donation", "count-drift",
+                f"donation markers in lowered HLO: expected {expected}, "
+                f"found {got} — donation annotations "
+                f"{'vanished at lowering' if got < expected else 'appeared unregistered'}; "
+                f"update ROUTE_DONATIONS only with the intentional change"))
+    for route in seen_routes - set(ROUTE_DONATIONS):
+        findings.append(_finding(
+            route, "coverage", "unregistered",
+            "traced route has no ROUTE_DONATIONS entry — register its "
+            "expected donation count"))
+    return findings
+
+
+def pin_cpu_for_contracts() -> None:
+    """The CLI front door for offline runs: pin the CPU backend before the
+    first trace (env var AND config update — the CLAUDE.md recipe; a
+    wedged dev tunnel hangs first backend init process-wide otherwise).
+    Honors an explicit operator override via ICT_TEST_TPU=1."""
+    import os
+
+    if os.environ.get("ICT_TEST_TPU"):
+        return
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — the env var still protects subprocs
+        pass

@@ -1,0 +1,448 @@
+"""AST source rules: the project's load-bearing conventions, mechanised.
+
+Rule catalog (docs/ANALYSIS.md has the full rationale + examples):
+
+- **ICT001/device-init** — ``jax.devices()``-class calls (anything that can
+  trigger first backend init: a wedged dev tunnel hangs it process-wide,
+  the CLAUDE.md quirk) are allowed only in ``utils/device_probe.py``,
+  lexically inside a ``with init_watchdog(...)`` block, or annotated
+  ``# ict: backend-init-ok(<how it is guarded>)``.
+- **ICT002/mask-f64** — no 64-bit float/complex dtypes in mask-affecting
+  modules (``ops/``, ``core/``, ``parallel/``, ``online/finalize.py``)
+  without ``# ict: f64-ok(<reason>)``: the oracle's numpy.ma f64 promotion
+  is *its* defined behavior; the jax route must stay uniformly 32-bit or
+  the masks drift (SURVEY §8.L9).
+- **ICT003/mask-nondet** — no wall-clock (``time.time``) or RNG
+  (``random``/``np.random``/``uuid``/``secrets``/``os.urandom``) calls in
+  mask-affecting modules without ``# ict: nondet-ok(<reason>)``: replay
+  determinism (spool resume, repro bundles, fuzz seeds) depends on the
+  mask path being a pure function of (cube, weights, config).
+- **ICT005/metric-name** — literal metric/phase names handed to the
+  :mod:`..obs.tracing` registries must fit the Prometheus grammar once
+  the ``ict_`` prefix lands (``[a-z][a-z0-9_]*``), and label keys
+  likewise.
+- **ICT005/metric-registration** — one family, one kind: a name used as
+  both counter and gauge (or both flat and labeled) would render twice
+  under the same ``ict_`` family on ``/metrics``; label-key sets per
+  family must be consistent across call sites.
+- **ICT006/numpy-in-jit** — no ``np.*`` *calls* inside jit-traced bodies
+  (they run at trace time on tracers, forcing host transfers or silent
+  constant-folding); dtype-object accesses (``np.float32`` & co.) are
+  trace-time constants and stay allowed.
+
+``ICT004/bench-exit`` (the bench.py CFG walk) lives in
+:mod:`.bench_cfg`; the race rules (ICT007/ICT008) in :mod:`.races`.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from iterative_cleaner_tpu.analysis.engine import Finding, SourceFile
+
+#: Modules whose code can affect a flag mask: every dtype / determinism
+#: rule applies here (docs/PARITY.md's behavior matrix is the map).
+MASK_MODULE_PREFIXES = (
+    "iterative_cleaner_tpu/ops/",
+    "iterative_cleaner_tpu/core/",
+    "iterative_cleaner_tpu/parallel/",
+)
+MASK_MODULES_EXACT = (
+    "iterative_cleaner_tpu/online/finalize.py",
+    "iterative_cleaner_tpu/backends/jax_backend.py",
+    "iterative_cleaner_tpu/backends/numpy_backend.py",
+)
+
+#: The one module allowed to touch backend init unguarded — it IS the guard.
+DEVICE_INIT_ALLOWED = ("iterative_cleaner_tpu/utils/device_probe.py",)
+
+#: Call attributes that can trigger first backend init.
+BACKEND_INIT_ATTRS = {
+    "devices", "local_devices", "device_count", "local_device_count",
+    "default_backend", "process_index", "process_count",
+}
+
+METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+LABEL_KEY_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
+
+#: tracing-registry entry points -> metric kind ("counter" / "gauge") and
+#: whether the family takes labels.
+REGISTRY_FNS = {
+    "count": ("counter", False),
+    "count_labeled": ("counter", True),
+    "observe_phase": ("counter", False),
+    "phase": ("counter", False),
+    "set_gauge": ("gauge", False),
+    "set_gauge_labeled": ("gauge", True),
+    "max_gauge_labeled": ("gauge", True),
+}
+
+#: np.<attr> calls that are trace-time constants, fine inside jit.
+NUMPY_TRACE_SAFE = {
+    "float16", "float32", "float64", "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool_", "complex64",
+    "complex128", "dtype", "finfo", "iinfo",
+}
+
+NONDET_EXACT = {"time.time", "time.time_ns", "os.urandom"}
+NONDET_PREFIXES = ("random.", "numpy.random.", "uuid.", "secrets.")
+
+
+def _import_canonical_map(tree: ast.AST) -> dict[str, str]:
+    """alias -> canonical dotted prefix, so import style cannot evade a
+    name-based rule: ``from time import time`` -> {'time': 'time.time'},
+    ``import numpy.random as npr`` -> {'npr': 'numpy.random'},
+    ``import numpy as np`` -> {'np': 'numpy'}."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                out[alias.asname or alias.name] = (
+                    f"{node.module}.{alias.name}")
+    return out
+
+
+def _canonical_call_name(node: ast.Call, aliases: dict[str, str]) -> str:
+    """The call target's dotted name with its leading alias resolved to
+    the canonical module path ('' when unresolvable)."""
+    name = dotted_name(node.func)
+    if not name:
+        return ""
+    head, _, rest = name.partition(".")
+    head = aliases.get(head, head)
+    return f"{head}.{rest}" if rest else head
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_mask_module(path: str) -> bool:
+    return (path.startswith(MASK_MODULE_PREFIXES)
+            or path in MASK_MODULES_EXACT)
+
+
+# --- ICT001: guarded backend init ---
+
+
+def _watchdog_guarded_lines(tree: ast.AST) -> set[int]:
+    """Line numbers lexically inside a ``with init_watchdog(...)`` block."""
+    guarded: set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            call = item.context_expr
+            if (isinstance(call, ast.Call)
+                    and (dotted_name(call.func) or "").endswith(
+                        "init_watchdog")):
+                guarded.update(range(node.lineno, node.end_lineno + 1))
+    return guarded
+
+
+def rule_device_init(sf: SourceFile) -> list[Finding]:
+    if sf.path in DEVICE_INIT_ALLOWED or sf.tree is None:
+        return []
+    guarded = _watchdog_guarded_lines(sf.tree)
+    # Bare aliases too: `from jax import devices [as d]` must not evade
+    # the rule by import style.
+    bare_aliases: set[str] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ImportFrom) and (node.module or "").split(
+                ".")[0] == "jax":
+            for alias in node.names:
+                if alias.name in BACKEND_INIT_ATTRS:
+                    bare_aliases.add(alias.asname or alias.name)
+    out = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func) or ""
+        parts = name.split(".")
+        if len(parts) == 1:
+            if parts[0] not in bare_aliases:
+                continue
+        elif parts[-1] not in BACKEND_INIT_ATTRS or parts[0] not in (
+                "jax", "xla_bridge", "_xb"):
+            continue
+        if node.lineno in guarded:
+            continue
+        if sf.annotation(node.lineno, "backend-init-ok") is not None:
+            continue
+        out.append(sf.finding(
+            "ICT001/device-init", node.lineno,
+            f"'{name}()' can trigger first backend init, which a wedged "
+            f"device tunnel hangs process-wide (CLAUDE.md); guard it via "
+            f"utils/device_probe.py (probe / init_watchdog / liveness "
+            f"gate) and annotate '# ict: backend-init-ok(<guard>)'"))
+    return out
+
+
+# --- ICT002: no 64-bit floats on the mask path ---
+
+
+_F64_NAMES = ("float64", "complex128")
+
+
+def _string_dtype_64(node: ast.Call) -> str | None:
+    """A 64-bit dtype smuggled in as a string: ``.astype("float64")``,
+    ``dtype="float64"`` keywords, ``np.dtype("complex128")``."""
+    def is64(n):
+        return (isinstance(n, ast.Constant) and isinstance(n.value, str)
+                and n.value in _F64_NAMES)
+
+    callee = (node.func.attr if isinstance(node.func, ast.Attribute)
+              else getattr(node.func, "id", ""))
+    if callee in ("astype", "dtype", "view") and node.args and is64(node.args[0]):
+        return node.args[0].value
+    for kw in node.keywords:
+        if kw.arg == "dtype" and is64(kw.value):
+            return kw.value.value
+    return None
+
+
+def rule_mask_f64(sf: SourceFile) -> list[Finding]:
+    if not is_mask_module(sf.path) or sf.tree is None:
+        return []
+    out = []
+    for node in ast.walk(sf.tree):
+        name = None
+        if isinstance(node, ast.Attribute) and node.attr in _F64_NAMES:
+            name = dotted_name(node) or node.attr
+        elif isinstance(node, ast.Name) and node.id in _F64_NAMES:
+            name = node.id
+        elif isinstance(node, ast.Call):
+            smuggled = _string_dtype_64(node)
+            if smuggled is not None:
+                name = f'"{smuggled}"'
+        if name is None:
+            continue
+        if sf.annotation(node.lineno, "f64-ok") is not None:
+            continue
+        out.append(sf.finding(
+            "ICT002/mask-f64", node.lineno,
+            f"64-bit dtype '{name}' in a mask-affecting module: the jax "
+            f"route must stay uniformly 32-bit for mask parity (SURVEY "
+            f"§8.L9); if deliberate (oracle-side promotion, x64-gated), "
+            f"annotate '# ict: f64-ok(<reason>)'"))
+    return out
+
+
+# --- ICT003: determinism of the mask path ---
+
+
+def rule_mask_nondet(sf: SourceFile) -> list[Finding]:
+    if not is_mask_module(sf.path) or sf.tree is None:
+        return []
+    aliases = _import_canonical_map(sf.tree)
+    out = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # Canonicalized through the import table, so `from time import
+        # time` / `import numpy.random as npr` cannot evade the rule.
+        name = _canonical_call_name(node, aliases)
+        if not (name in NONDET_EXACT
+                or name.startswith(NONDET_PREFIXES)):
+            continue
+        if sf.annotation(node.lineno, "nondet-ok") is not None:
+            continue
+        out.append(sf.finding(
+            "ICT003/mask-nondet", node.lineno,
+            f"nondeterministic call '{name}()' in a mask-affecting "
+            f"module: masks must be a pure function of (cube, weights, "
+            f"config) for replay/resume/audit determinism; if it cannot "
+            f"reach a mask, annotate '# ict: nondet-ok(<reason>)'"))
+    return out
+
+
+# --- ICT005: Prometheus metric grammar + single registration ---
+
+
+def _registry_calls(sf: SourceFile):
+    """Yield (node, fn_name, kind, labeled) for tracing-registry calls."""
+    if sf.tree is None:
+        return
+    in_tracing = sf.path.endswith("obs/tracing.py")
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = None
+        if isinstance(node.func, ast.Attribute):
+            base = node.func.value
+            if (isinstance(base, ast.Name) and "tracing" in base.id
+                    and node.func.attr in REGISTRY_FNS):
+                fn = node.func.attr
+        elif (isinstance(node.func, ast.Name) and in_tracing
+                and node.func.id in REGISTRY_FNS):
+            fn = node.func.id
+        if fn is not None:
+            kind, labeled = REGISTRY_FNS[fn]
+            yield node, fn, kind, labeled
+
+
+def rule_metric_grammar(sf: SourceFile) -> list[Finding]:
+    out = []
+    for node, fn, _kind, labeled in _registry_calls(sf):
+        if not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            if not METRIC_NAME_RE.match(first.value):
+                out.append(sf.finding(
+                    "ICT005/metric-name", node.lineno,
+                    f"metric/phase name {first.value!r} (via {fn}) breaks "
+                    f"the Prometheus grammar once prefixed 'ict_' — want "
+                    f"[a-z][a-z0-9_]*"))
+        if labeled and len(node.args) > 1 and isinstance(node.args[1], ast.Dict):
+            for key in node.args[1].keys:
+                if (isinstance(key, ast.Constant)
+                        and isinstance(key.value, str)
+                        and not LABEL_KEY_RE.match(key.value)):
+                    out.append(sf.finding(
+                        "ICT005/metric-name", node.lineno,
+                        f"label key {key.value!r} (via {fn}) breaks the "
+                        f"Prometheus label grammar [a-z_][a-z0-9_]*"))
+    return out
+
+
+def rule_metric_registration(files: list[SourceFile]) -> list[Finding]:
+    """Cross-file: one family name, one (kind, labeledness, label-key set).
+
+    ``observe_phase``/``phase`` families are checked against each other
+    and against flat counters (they share the ``ict_<name>_s/_n``
+    namespace); a family seen as both counter and gauge, or both flat and
+    labeled, would collide in the rendered exposition."""
+    seen: dict[str, tuple[str, bool, tuple, SourceFile, int]] = {}
+    out: list[Finding] = []
+    for sf in files:
+        for node, fn, kind, labeled in _registry_calls(sf):
+            if not node.args:
+                continue
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)):
+                continue
+            name = first.value
+            keys: tuple = ()
+            if labeled and len(node.args) > 1 and isinstance(
+                    node.args[1], ast.Dict):
+                keys = tuple(sorted(
+                    k.value for k in node.args[1].keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)))
+            prior = seen.get(name)
+            if prior is None:
+                seen[name] = (kind, labeled, keys, sf, node.lineno)
+                continue
+            pkind, plabeled, pkeys, psf, pline = prior
+            if (kind, labeled) != (pkind, plabeled):
+                out.append(sf.finding(
+                    "ICT005/metric-registration", node.lineno,
+                    f"metric family {name!r} registered as "
+                    f"{'labeled ' if labeled else ''}{kind} here but as "
+                    f"{'labeled ' if plabeled else ''}{pkind} at "
+                    f"{psf.path}:{pline} — one family, one kind"))
+            elif labeled and keys and pkeys and keys != pkeys:
+                out.append(sf.finding(
+                    "ICT005/metric-registration", node.lineno,
+                    f"metric family {name!r} uses label keys "
+                    f"{list(keys)} here but {list(pkeys)} at "
+                    f"{psf.path}:{pline} — label sets must match"))
+    return out
+
+
+# --- ICT006: no numpy calls inside jit-traced bodies ---
+
+
+def _jitted_functions(tree: ast.Module) -> list[ast.FunctionDef]:
+    """Function defs that are jit entry points: decorated with jax.jit /
+    partial(jax.jit, ...), or wrapped by a module-level
+    ``x = jax.jit(f)`` / ``x = partial(jax.jit, ...)(f)`` assignment."""
+
+    def mentions_jit(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and sub.attr == "jit":
+                return True
+            if isinstance(sub, ast.Name) and sub.id == "jit":
+                return True
+        return False
+
+    by_name: dict[str, ast.FunctionDef] = {}
+    jitted: list[ast.FunctionDef] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            by_name.setdefault(node.name, node)
+            if any(mentions_jit(d) for d in node.decorator_list):
+                jitted.append(node)
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and mentions_jit(node.value.func)):
+            for arg in node.value.args:
+                if isinstance(arg, ast.Name) and arg.id in by_name:
+                    fn = by_name[arg.id]
+                    if fn not in jitted:
+                        jitted.append(fn)
+    return jitted
+
+
+def rule_numpy_in_jit(sf: SourceFile) -> list[Finding]:
+    if sf.tree is None:
+        return []
+    out = []
+    for fn in _jitted_functions(sf.tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in ("np", "numpy")):
+                continue
+            if func.attr in NUMPY_TRACE_SAFE:
+                continue
+            out.append(sf.finding(
+                "ICT006/numpy-in-jit", node.lineno,
+                f"'np.{func.attr}()' inside jit-traced '{fn.name}': numpy "
+                f"calls run at trace time (host transfer / silent "
+                f"constant-folding on tracers) — use jnp, or hoist the "
+                f"value out of the traced body"))
+    return out
+
+
+def run_source_rules(files: list[SourceFile]) -> list[Finding]:
+    """Every per-file rule plus the cross-file registration check (the
+    bench CFG rule rides along for bench.py — see :mod:`.bench_cfg`)."""
+    from iterative_cleaner_tpu.analysis.bench_cfg import rule_bench_exit
+    from iterative_cleaner_tpu.analysis.engine import malformed_annotations
+
+    out: list[Finding] = []
+    for sf in files:
+        if sf.parse_error:
+            out.append(sf.finding("ICT000/annotation-grammar", 1,
+                                  f"file does not parse: {sf.parse_error}"))
+            continue
+        out.extend(malformed_annotations(sf))
+        out.extend(rule_device_init(sf))
+        out.extend(rule_mask_f64(sf))
+        out.extend(rule_mask_nondet(sf))
+        out.extend(rule_metric_grammar(sf))
+        out.extend(rule_numpy_in_jit(sf))
+        out.extend(rule_bench_exit(sf))
+    out.extend(rule_metric_registration(
+        [sf for sf in files if not sf.parse_error]))
+    return out

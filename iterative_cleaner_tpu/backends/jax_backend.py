@@ -352,7 +352,7 @@ def _x64_dtype(cfg: CleanConfig):
             "CleanConfig(x64=True) needs float64 support enabled before any "
             "JAX computation: set JAX_ENABLE_X64=1 or "
             "jax.config.update('jax_enable_x64', True) at startup")
-    return jnp.float64
+    return jnp.float64  # ict: f64-ok(explicit --x64 opt-in; parity docs cover it)
 
 
 class JaxCleaner:

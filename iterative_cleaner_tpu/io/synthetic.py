@@ -33,6 +33,12 @@ class RFISpec:
     amplitude: float = 40.0         # RFI strength in units of noise sigma
 
 
+#: make_archive's default injection mix (a frozen spec, so one shared
+#: instance is safe — and keeps the call out of the argument default,
+#: where a later mutable refactor would silently share state: ruff B008).
+_DEFAULT_RFI = RFISpec()
+
+
 def pulse_profile(nbin: int, width_frac: float = 0.03, phase: float = 0.30) -> np.ndarray:
     """A Gaussian pulse template in phase bins."""
     x = np.arange(nbin, dtype=np.float64) / nbin
@@ -49,7 +55,7 @@ def make_archive(
     npol: int = 1,
     seed: int = 0,
     snr: float = 25.0,
-    rfi: RFISpec | None = RFISpec(),
+    rfi: RFISpec | None = _DEFAULT_RFI,
     dm: float = 12.455,
     period: float = 0.714,
     centre_frequency: float = 149.0,

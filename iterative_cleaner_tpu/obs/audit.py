@@ -370,7 +370,7 @@ class ShadowAuditor(threading.Thread):
         # and decremented only after the audit completes: drain() keys off
         # this, not queue emptiness, so the instant between a dequeue and
         # the audit starting can never read as "idle".
-        self._outstanding = 0
+        self._outstanding = 0  # ict: guarded-by(self._lock)
         self._lock = threading.Lock()
         self._stop_evt = threading.Event()
 

@@ -46,12 +46,12 @@ _current: contextvars.ContextVar[TraceContext | None] = contextvars.ContextVar(
     "ict_trace_ctx", default=None)
 
 _UNSET = object()
-_explicit = _UNSET          # configure() override; _UNSET -> read the env
+_explicit = _UNSET          # configure() override; _UNSET -> read the env  # ict: guarded-by(_lock)
 _lock = threading.Lock()
-_fh = None                  # cached append handle for the active path
-_fh_path: str | None = None
-_warned = False
-_retry_at = 0.0             # sink-failure backoff deadline (monotonic)
+_fh = None                  # cached append handle for the active path  # ict: guarded-by(_lock)
+_fh_path: str | None = None  # ict: guarded-by(_lock)
+_warned = False  # ict: guarded-by(_lock)
+_retry_at = 0.0             # sink-failure backoff deadline (monotonic)  # ict: guarded-by(_lock)
 
 #: After a failed sink write, drop events for this long, then try again —
 #: transient disk trouble (brief ENOSPC, a remounted log volume) must not

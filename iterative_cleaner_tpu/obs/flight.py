@@ -34,7 +34,7 @@ DEFAULT_CAPACITY = 512
 MAX_DUMPS_KEPT = 20
 
 _lock = threading.Lock()
-_ring: collections.deque = collections.deque(maxlen=DEFAULT_CAPACITY)
+_ring: collections.deque = collections.deque(maxlen=DEFAULT_CAPACITY)  # ict: guarded-by(_lock)
 
 
 def enabled() -> bool:

@@ -32,12 +32,16 @@ _ENV_OVERRIDE = "ICT_HBM_BYTES"
 
 #: Devices whose memory_stats() raised once (backends without
 #: introspection raise the same way forever — don't pay the exception per
-#: scrape).
-_stats_unsupported: set = set()
+#: scrape).  Lock-free on purpose: set.add of a value that is a static
+#: fact of the device is idempotent under any interleaving.
+_stats_unsupported: set = set()  # ict: guarded-by(none: idempotent value-stable cache)
 
 #: shape_bucket -> executable analysis dict (analyze once per bucket; the
 #: AOT compile behind it is the expensive part and the answer is static).
-_exec_registry: dict[str, dict] = {}
+#: Lock-free on purpose: every writer stores the same static analysis for
+#: a key, so the worst race costs one duplicate AOT compile, never a
+#: wrong value.
+_exec_registry: dict[str, dict] = {}  # ict: guarded-by(none: idempotent value-stable cache)
 
 
 def hbm_override_bytes() -> int | None:
@@ -91,7 +95,7 @@ def device_memory_bytes(device=None, default_device_fn=None) -> int | None:
                 return None
             import jax
 
-            device = jax.devices()[0]
+            device = jax.devices()[0]  # ict: backend-init-ok(gated on backend_live() above)
     stats = device_stats(device)
     if stats is None:
         return None
@@ -127,7 +131,7 @@ def device_snapshot() -> list[dict]:
     try:
         import jax
 
-        devices = jax.local_devices()
+        devices = jax.local_devices()  # ict: backend-init-ok(gated on backend_live() above)
     except Exception:  # noqa: BLE001 — introspection is best-effort
         return []
     out = []

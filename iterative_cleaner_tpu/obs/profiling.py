@@ -31,7 +31,7 @@ from iterative_cleaner_tpu.obs import flight
 DEFAULT_MAX_CAPTURE_S = 60.0
 
 _lock = threading.Lock()          # held only to mutate _active, never I/O
-_active: dict | None = None       # {"dir", "started_s", "until_s", "timer"}
+_active: dict | None = None       # {"dir", "started_s", "until_s", "timer"}  # ict: guarded-by(_lock)
 
 
 def max_capture_s() -> float:

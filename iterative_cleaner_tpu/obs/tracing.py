@@ -41,11 +41,11 @@ from iterative_cleaner_tpu.obs.profiling import profile_trace  # noqa: F401
 #: a 16-entry linear scan — no histogram state to size.
 HIST_BOUNDS: tuple[float, ...] = tuple(2.0 ** e for e in range(-10, 6))
 
-_counters: dict[str, float] = {}
-_labeled: dict[tuple[str, tuple[tuple[str, str], ...]], float] = {}
-_gauges: dict[str, float] = {}
-_labeled_gauges: dict[tuple[str, tuple[tuple[str, str], ...]], float] = {}
-_hists: dict[str, list[int]] = {}
+_counters: dict[str, float] = {}  # ict: guarded-by(_counters_lock)
+_labeled: dict[tuple[str, tuple[tuple[str, str], ...]], float] = {}  # ict: guarded-by(_counters_lock)
+_gauges: dict[str, float] = {}  # ict: guarded-by(_counters_lock)
+_labeled_gauges: dict[tuple[str, tuple[tuple[str, str], ...]], float] = {}  # ict: guarded-by(_counters_lock)
+_hists: dict[str, list[int]] = {}  # ict: guarded-by(_counters_lock)
 _counters_lock = threading.Lock()
 
 
@@ -214,7 +214,9 @@ def reset_counters() -> None:
 # --- compile accounting (utils/compile_cache.py + the jax monitoring bus) ---
 
 _tls = threading.local()
-_listener_installed = False
+# Set-once latch, written only from single-threaded process setup (CLI
+# main / daemon _start_locked / bench init before any worker exists).
+_listener_installed = False  # ict: guarded-by(none: set once during single-threaded startup)
 
 
 def shape_bucket_label(shape) -> str:

@@ -62,7 +62,9 @@ def dispersion_shifts(
     """
     if dm == 0.0 or period <= 0:
         return np.zeros(len(freqs), dtype=np.int64)
-    delay = DM_CONST * dm * (np.asarray(freqs, np.float64) ** -2 - float(ref_freq) ** -2)
+    delay = DM_CONST * dm * (
+        np.asarray(freqs, np.float64) ** -2  # ict: f64-ok(host preprocessing shared by BOTH backends)
+        - float(ref_freq) ** -2)
     return np.round(delay / period * nbin).astype(np.int64) % nbin
 
 
@@ -91,7 +93,8 @@ def remove_baseline(cube: np.ndarray, weights: np.ndarray, frac: float = BASELIN
     ``cube`` is (nsub, nchan, nbin) *dedispersed*; ``weights`` (nsub, nchan).
     """
     nbin = cube.shape[-1]
-    total = np.einsum("sc,scb->b", weights.astype(np.float64), cube.astype(np.float64))
+    total = np.einsum(
+        "sc,scb->b", weights.astype(np.float64), cube.astype(np.float64))  # ict: f64-ok(shared host path)
     start, width = baseline_window(total, frac)
     idx = (start + np.arange(width)) % nbin
     # f64 accumulation: the native (C++) preprocess accumulates in double, and
@@ -99,10 +102,10 @@ def remove_baseline(cube: np.ndarray, weights: np.ndarray, frac: float = BASELIN
     # both hosts produce bit-identical cubes.  The subtraction runs per
     # subint to keep the f64 temporaries at nchan*nbin instead of tripling
     # peak host memory at GB cube scales.
-    base = cube[..., idx].mean(axis=-1, keepdims=True, dtype=np.float64)
+    base = cube[..., idx].mean(axis=-1, keepdims=True, dtype=np.float64)  # ict: f64-ok(see f64 note above)
     out = np.empty_like(cube, dtype=np.float32)
     for s in range(cube.shape[0]):
-        out[s] = (cube[s].astype(np.float64) - base[s]).astype(np.float32)
+        out[s] = (cube[s].astype(np.float64) - base[s]).astype(np.float32)  # ict: f64-ok(see f64 note above)
     return out
 
 

@@ -226,7 +226,7 @@ class ChunkedJaxCleaner:
             INCREMENTAL_TEMPLATE_BUDGET,
         )
 
-        host_dt = np.float64 if self.cfg.x64 else np.float32
+        host_dt = np.float64 if self.cfg.x64 else np.float32  # ict: f64-ok(explicit --x64 opt-in)
         tmpl = None
         dense = False  # provenance of the value we end up carrying
         if self.cfg.incremental_template and self._tmpl_w is not None:
@@ -325,7 +325,7 @@ class ChunkedJaxCleaner:
                 # documented for SCORES only, not output data).
                 template = self._template(
                     jnp.asarray(self._resid_w_prev, self._dtype))
-            res_dtype = np.float64 if self.cfg.x64 else np.float32
+            res_dtype = np.float64 if self.cfg.x64 else np.float32  # ict: f64-ok(explicit --x64 opt-in)
             self._residual = np.empty(self._D.shape, res_dtype)
             for lo, hi in self._blocks():
                 Dblk = jnp.asarray(self._D[lo:hi], self._dtype)

@@ -24,7 +24,10 @@ import jax
 
 def process_topology() -> tuple[int, int]:
     """(process_index, process_count) — (0, 1) in single-process runs."""
-    return jax.process_index(), jax.process_count()
+    # Multi-controller entry: callers ran jax.distributed.initialize (an
+    # explicit operator action) before partitioning, so backend init here
+    # is deliberate, not a stray first touch.
+    return jax.process_index(), jax.process_count()  # ict: backend-init-ok(post-distributed-init entry)
 
 
 def partition_paths(
@@ -52,4 +55,4 @@ def local_mesh(**kw):
     they mean."""
     from iterative_cleaner_tpu.parallel.mesh import make_mesh
 
-    return make_mesh(devices=jax.local_devices(), **kw)
+    return make_mesh(devices=jax.local_devices(), **kw)  # ict: backend-init-ok(post-distributed-init entry)

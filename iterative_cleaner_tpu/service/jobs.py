@@ -110,7 +110,9 @@ class JobSpool:
         self.root = root
         os.makedirs(root, exist_ok=True)
         self._lock = threading.Lock()
-        self._flock_fd: int | None = None
+        # Written only by start()/stop() on the daemon's lifecycle thread;
+        # worker/HTTP threads never touch the flock fd.
+        self._flock_fd: int | None = None  # ict: guarded-by(none: lifecycle-thread only)
 
     def acquire_exclusive(self) -> None:
         """Take the spool's single-daemon flock.  Two daemons on one spool
@@ -124,11 +126,12 @@ class JobSpool:
                      os.O_CREAT | os.O_RDWR, 0o644)
         try:
             fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-        except OSError:
+        except OSError as exc:
             os.close(fd)
             raise RuntimeError(
                 f"spool {self.root!r} is already served by another daemon "
-                "(its .lock is held); use a separate --spool per daemon")
+                "(its .lock is held); use a separate --spool per daemon"
+            ) from exc
         self._flock_fd = fd
 
     def release_exclusive(self) -> None:

@@ -69,7 +69,7 @@ class ShapeBucketScheduler:
         self.bucket_cap = 1 << (int(bucket_cap).bit_length() - 1)
         self.deadline_s = float(deadline_s)
         self._flush_fn = flush_fn
-        self._buckets: dict[tuple, list[Entry]] = {}
+        self._buckets: dict[tuple, list[Entry]] = {}  # ict: guarded-by(self._lock)
         self._lock = threading.Lock()
 
     def offer(self, job: Job, archive: Archive, D, w0) -> None:

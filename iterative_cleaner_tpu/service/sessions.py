@@ -66,9 +66,9 @@ class SessionManager:
         self.alert_iters = int(alert_iters)
         self.quiet = quiet
         os.makedirs(root, exist_ok=True)
-        self._live: dict[str, OnlineSession] = {}
-        self._out_paths: dict[str, str] = {}
-        self._trace_ids: dict[str, str] = {}   # telemetry context per session
+        self._live: dict[str, OnlineSession] = {}  # ict: guarded-by(self._lock)
+        self._out_paths: dict[str, str] = {}  # ict: guarded-by(self._lock)
+        self._trace_ids: dict[str, str] = {}   # telemetry context per session  # ict: guarded-by(self._lock)
         self._lock = threading.Lock()          # the maps
         self._pass_lock = threading.Lock()     # device passes serialize
         self._locks: dict[str, threading.Lock] = {}  # per-session ordering

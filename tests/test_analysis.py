@@ -1,0 +1,883 @@
+"""The static analysis suite (iterative_cleaner_tpu/analysis, tools/
+ict_lint.py): per-rule fixture snippets (positive AND negative), the
+seeded lock-order-inversion fixture the detector must catch, the
+bench.py exit-path CFG rule, the tree-is-clean gate, and the jaxpr
+contract checker pinned on all four routes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from iterative_cleaner_tpu.analysis.engine import (
+    Finding,
+    collect_project_files,
+    load_baseline,
+    load_source_file,
+    parse_annotations,
+    split_baselined,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _sf(tmp_path, source: str, name: str = "fixture.py", relname=None):
+    """Write a snippet and load it as a SourceFile under a repo-shaped
+    relative path (rules key off path prefixes)."""
+    rel = relname or name
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return load_source_file(str(tmp_path), rel)
+
+
+def _rules_of(findings: list[Finding]) -> set[str]:
+    return {f.rule for f in findings}
+
+
+# --- engine ---
+
+
+class TestEngine:
+    def test_annotation_parsing_and_placement(self, tmp_path):
+        sf = _sf(tmp_path, """\
+            # ict: guarded-by(_lock)
+            x = {}
+            y = {}  # ict: guarded-by(_lock)
+            z = {}
+        """)
+        assert sf.annotation(2, "guarded-by") == "_lock"   # comment above
+        assert sf.annotation(3, "guarded-by") == "_lock"   # trailing
+        assert sf.annotation(4, "guarded-by") is None      # y's trailing
+        #                      comment must NOT leak onto the next line
+
+    def test_malformed_annotation_is_a_finding(self, tmp_path):
+        from iterative_cleaner_tpu.analysis.engine import malformed_annotations
+
+        sf = _sf(tmp_path, "x = {}  # ict: guarded-by()\n")
+        findings = malformed_annotations(sf)
+        assert len(findings) == 1
+        assert "non-empty" in findings[0].message
+        sf2 = _sf(tmp_path, "x = {}  # ict: made-up-kind(reason)\n",
+                  name="f2.py")
+        assert len(malformed_annotations(sf2)) == 1
+
+    def test_fingerprint_stable_across_line_moves(self, tmp_path):
+        sf_a = _sf(tmp_path, "import time\nbad = time.time\n")
+        sf_b = _sf(tmp_path, "import time\n\n\nbad = time.time\n",
+                   name="g.py")
+        f_a = sf_a.finding("R", 2, "m")
+        f_b = sf_b.finding("R", 4, "m")
+        f_b.path = f_a.path
+        assert f_a.fingerprint == f_b.fingerprint
+
+    def test_baseline_roundtrip_suppresses(self, tmp_path):
+        from iterative_cleaner_tpu.analysis.engine import write_baseline
+
+        sf = _sf(tmp_path, "x = 1\n")
+        finding = sf.finding("R/x", 1, "msg")
+        path = tmp_path / "baseline.json"
+        write_baseline(str(path), [finding])
+        fresh, suppressed = split_baselined(
+            [finding], load_baseline(str(path)))
+        assert fresh == [] and suppressed == [finding]
+
+
+# --- ICT001 device-init ---
+
+
+class TestDeviceInit:
+    SRC_BAD = """\
+        import jax
+
+        def probe():
+            return jax.devices()
+    """
+
+    def test_positive(self, tmp_path):
+        from iterative_cleaner_tpu.analysis.rules import rule_device_init
+
+        sf = _sf(tmp_path, self.SRC_BAD,
+                 relname="iterative_cleaner_tpu/service/x.py")
+        assert _rules_of(rule_device_init(sf)) == {"ICT001/device-init"}
+
+    def test_watchdog_guard_negative(self, tmp_path):
+        from iterative_cleaner_tpu.analysis.rules import rule_device_init
+
+        sf = _sf(tmp_path, """\
+            import jax
+            from iterative_cleaner_tpu.utils.device_probe import init_watchdog
+
+            def probe():
+                with init_watchdog("x"):
+                    return jax.devices()
+        """, relname="iterative_cleaner_tpu/service/x.py")
+        assert rule_device_init(sf) == []
+
+    def test_annotation_negative(self, tmp_path):
+        from iterative_cleaner_tpu.analysis.rules import rule_device_init
+
+        sf = _sf(tmp_path, """\
+            import jax
+
+            def probe():
+                return jax.devices()  # ict: backend-init-ok(gated upstream)
+        """, relname="iterative_cleaner_tpu/service/x.py")
+        assert rule_device_init(sf) == []
+
+    def test_bare_import_alias_caught(self, tmp_path):
+        """`from jax import devices` must not evade the rule by import
+        style (review regression)."""
+        from iterative_cleaner_tpu.analysis.rules import rule_device_init
+
+        sf = _sf(tmp_path, """\
+            from jax import local_devices as ld
+
+            def probe():
+                return ld()
+        """, relname="iterative_cleaner_tpu/service/x.py")
+        assert _rules_of(rule_device_init(sf)) == {"ICT001/device-init"}
+
+    def test_device_probe_module_exempt(self, tmp_path):
+        from iterative_cleaner_tpu.analysis.rules import rule_device_init
+
+        sf = _sf(tmp_path, self.SRC_BAD,
+                 relname="iterative_cleaner_tpu/utils/device_probe.py")
+        assert rule_device_init(sf) == []
+
+
+# --- ICT002 / ICT003 mask-module hygiene ---
+
+
+class TestMaskRules:
+    def test_f64_positive_and_annotated(self, tmp_path):
+        from iterative_cleaner_tpu.analysis.rules import rule_mask_f64
+
+        bad = _sf(tmp_path, "import numpy as np\nDT = np.float64\n",
+                  relname="iterative_cleaner_tpu/ops/x.py")
+        assert _rules_of(rule_mask_f64(bad)) == {"ICT002/mask-f64"}
+        ok = _sf(tmp_path,
+                 "import numpy as np\nDT = np.float64  # ict: f64-ok(why)\n",
+                 relname="iterative_cleaner_tpu/ops/y.py")
+        assert rule_mask_f64(ok) == []
+
+    def test_f64_outside_mask_modules_ignored(self, tmp_path):
+        from iterative_cleaner_tpu.analysis.rules import rule_mask_f64
+
+        sf = _sf(tmp_path, "import numpy as np\nDT = np.float64\n",
+                 relname="iterative_cleaner_tpu/obs/x.py")
+        assert rule_mask_f64(sf) == []
+
+    def test_nondet_positive_and_annotated(self, tmp_path):
+        from iterative_cleaner_tpu.analysis.rules import rule_mask_nondet
+
+        bad = _sf(tmp_path, """\
+            import time, random
+
+            def f():
+                return time.time() + random.random()
+        """, relname="iterative_cleaner_tpu/core/x.py")
+        assert len(rule_mask_nondet(bad)) == 2
+        ok = _sf(tmp_path, """\
+            import time
+
+            def f():
+                return time.time()  # ict: nondet-ok(telemetry timestamp only)
+        """, relname="iterative_cleaner_tpu/core/y.py")
+        assert rule_mask_nondet(ok) == []
+
+    def test_nondet_import_style_evasion_caught(self, tmp_path):
+        """`from time import time` / `import numpy.random as npr` must
+        not evade ICT003 (review regression)."""
+        from iterative_cleaner_tpu.analysis.rules import rule_mask_nondet
+
+        sf = _sf(tmp_path, """\
+            from time import time
+            import numpy.random as npr
+
+            def f():
+                return time() + npr.normal()
+        """, relname="iterative_cleaner_tpu/core/w.py")
+        assert len(rule_mask_nondet(sf)) == 2
+
+    def test_string_dtype_smuggling_caught(self, tmp_path):
+        """astype("float64") / dtype="float64" are the same f64 mixing
+        as np.float64 (review regression)."""
+        from iterative_cleaner_tpu.analysis.rules import rule_mask_f64
+
+        sf = _sf(tmp_path, """\
+            import numpy as np
+
+            def f(x):
+                a = x.astype("float64")
+                b = np.empty(3, dtype="complex128")
+                return a, b
+        """, relname="iterative_cleaner_tpu/ops/w.py")
+        assert len(rule_mask_f64(sf)) == 2
+
+    def test_perf_counter_is_fine(self, tmp_path):
+        from iterative_cleaner_tpu.analysis.rules import rule_mask_nondet
+
+        sf = _sf(tmp_path, """\
+            import time
+
+            def f():
+                return time.perf_counter()
+        """, relname="iterative_cleaner_tpu/core/z.py")
+        assert rule_mask_nondet(sf) == []
+
+
+# --- ICT004 bench exit CFG ---
+
+
+class TestBenchExit:
+    def test_missing_emit_before_return(self, tmp_path):
+        from iterative_cleaner_tpu.analysis.bench_cfg import rule_bench_exit
+
+        sf = _sf(tmp_path, """\
+            def _emit(p):
+                print(p)
+
+            def main():
+                try:
+                    payload = {}
+                except Exception:
+                    return 1
+                _emit(payload)
+                return 0
+        """, name="bench.py")
+        findings = rule_bench_exit(sf)
+        assert len(findings) == 1 and findings[0].line == 8
+
+    def test_only_root_bench_is_in_scope(self, tmp_path):
+        """The payload contract binds the repo-root bench.py alone — a
+        future tools/microbench.py owes no _emit (review regression:
+        endswith matched any *bench.py)."""
+        from iterative_cleaner_tpu.analysis.bench_cfg import rule_bench_exit
+
+        sf = _sf(tmp_path, """\
+            import sys
+
+            def main():
+                return 0
+
+            sys.exit(main())
+        """, relname="tools/microbench.py")
+        assert rule_bench_exit(sf) == []
+
+    def test_emit_on_every_path_passes(self, tmp_path):
+        from iterative_cleaner_tpu.analysis.bench_cfg import rule_bench_exit
+
+        sf = _sf(tmp_path, """\
+            import os, sys
+
+            def _emit(p):
+                print(p)
+
+            def _watchdog():
+                def fire():
+                    _emit({})
+                    os._exit(2)
+                return fire
+
+            def main():
+                try:
+                    payload = {}
+                except Exception:
+                    _emit({})
+                    return 1
+                _emit(payload)
+                return 0
+
+            if __name__ == "__main__":
+                sys.exit(main())
+        """, name="bench.py")
+        assert rule_bench_exit(sf) == []
+
+    def test_unguarded_hard_exit_in_nested_fn(self, tmp_path):
+        from iterative_cleaner_tpu.analysis.bench_cfg import rule_bench_exit
+
+        sf = _sf(tmp_path, """\
+            import os
+
+            def _emit(p):
+                print(p)
+
+            def main():
+                _emit({})
+                return 0
+
+            def watchdog():
+                os._exit(2)
+        """, name="bench.py")
+        findings = rule_bench_exit(sf)
+        assert len(findings) == 1 and "os._exit" in findings[0].message
+
+    def test_return_inside_match_case_caught(self, tmp_path):
+        """Exit paths inside match statements are walked too (review
+        regression)."""
+        from iterative_cleaner_tpu.analysis.bench_cfg import rule_bench_exit
+
+        sf = _sf(tmp_path, """\
+            def _emit(p):
+                print(p)
+
+            def main(mode):
+                match mode:
+                    case "fast":
+                        return 1
+                    case _:
+                        pass
+                _emit({})
+                return 0
+        """, name="bench.py")
+        findings = rule_bench_exit(sf)
+        assert len(findings) == 1 and findings[0].line == 7
+
+    def test_real_bench_is_clean(self):
+        from iterative_cleaner_tpu.analysis.bench_cfg import rule_bench_exit
+
+        sf = load_source_file(REPO_ROOT, "bench.py")
+        assert rule_bench_exit(sf) == []
+
+
+# --- ICT005 metric grammar / registration ---
+
+
+class TestMetricRules:
+    def test_grammar_positive(self, tmp_path):
+        from iterative_cleaner_tpu.analysis.rules import rule_metric_grammar
+
+        sf = _sf(tmp_path, """\
+            from iterative_cleaner_tpu.obs import tracing
+
+            tracing.count("Bad-Name")
+            tracing.count_labeled("fine_name", {"Bad-Key": "v"})
+        """)
+        assert len(rule_metric_grammar(sf)) == 2
+
+    def test_registration_conflict(self, tmp_path):
+        from iterative_cleaner_tpu.analysis.rules import (
+            rule_metric_registration,
+        )
+
+        sf = _sf(tmp_path, """\
+            from iterative_cleaner_tpu.obs import tracing
+
+            tracing.count("my_family")
+            tracing.set_gauge("my_family", 1.0)
+            tracing.count_labeled("fam2", {"route": "a"})
+            tracing.count_labeled("fam2", {"shape": "b"})
+        """)
+        findings = rule_metric_registration([sf])
+        msgs = " | ".join(f.message for f in findings)
+        assert len(findings) == 2
+        assert "one family, one kind" in msgs
+        assert "label keys" in msgs
+
+
+# --- ICT006 numpy-in-jit ---
+
+
+class TestNumpyInJit:
+    def test_positive_decorated_and_wrapped(self, tmp_path):
+        from iterative_cleaner_tpu.analysis.rules import rule_numpy_in_jit
+
+        sf = _sf(tmp_path, """\
+            import jax
+            import numpy as np
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("n",))
+            def f(x, *, n):
+                return np.sum(x)
+
+            def g(x):
+                return np.asarray(x)
+
+            g_jit = jax.jit(g)
+        """)
+        assert len(rule_numpy_in_jit(sf)) == 2
+
+    def test_dtype_constants_allowed(self, tmp_path):
+        from iterative_cleaner_tpu.analysis.rules import rule_numpy_in_jit
+
+        sf = _sf(tmp_path, """\
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return x.astype(np.float32) + np.finfo(np.float32).eps
+        """)
+        assert rule_numpy_in_jit(sf) == []
+
+    def test_unjitted_numpy_ignored(self, tmp_path):
+        from iterative_cleaner_tpu.analysis.rules import rule_numpy_in_jit
+
+        sf = _sf(tmp_path, """\
+            import numpy as np
+
+            def f(x):
+                return np.sum(x)
+        """)
+        assert rule_numpy_in_jit(sf) == []
+
+
+# --- ICT007 guarded-by ---
+
+
+class TestGuardedBy:
+    def _run(self, *sfs):
+        from iterative_cleaner_tpu.analysis.races import run_race_rules
+
+        return run_race_rules(list(sfs))
+
+    def test_unannotated_global_flagged_with_fix(self, tmp_path):
+        sf = _sf(tmp_path, """\
+            import threading
+
+            _lock = threading.Lock()
+            _registry = {}
+
+            def add(k, v):
+                with _lock:
+                    _registry[k] = v
+
+            def drop(k):
+                with _lock:
+                    _registry.pop(k, None)
+        """, relname="iterative_cleaner_tpu/obs/fixture.py")
+        findings = self._run(sf)
+        assert _rules_of(findings) == {"ICT007/guarded-by"}
+        # Every write already sits under _lock -> mechanical fix offered.
+        assert findings[0].fix_append == "# ict: guarded-by(_lock)"
+
+    def test_write_outside_declared_lock(self, tmp_path):
+        sf = _sf(tmp_path, """\
+            import threading
+
+            _lock = threading.Lock()
+            _registry = {}  # ict: guarded-by(_lock)
+
+            def add(k, v):
+                _registry[k] = v
+        """, relname="iterative_cleaner_tpu/obs/fixture.py")
+        findings = self._run(sf)
+        assert len(findings) == 1
+        assert "outside its declared lock" in findings[0].message
+
+    def test_annotated_and_guarded_is_clean(self, tmp_path):
+        sf = _sf(tmp_path, """\
+            import threading
+
+            _lock = threading.Lock()
+            _registry = {}  # ict: guarded-by(_lock)
+
+            def add(k, v):
+                with _lock:
+                    _registry[k] = v
+        """, relname="iterative_cleaner_tpu/obs/fixture.py")
+        assert self._run(sf) == []
+
+    def test_deferred_callback_write_is_not_guarded(self, tmp_path):
+        """A write inside a lambda/nested def runs LATER, on whatever
+        thread invokes it — the lexical `with _lock:` around its
+        definition must not count (review regression: the Timer-callback
+        false negative)."""
+        sf = _sf(tmp_path, """\
+            import threading
+
+            _lock = threading.Lock()
+            _registry = {}  # ict: guarded-by(_lock)
+
+            def schedule():
+                with _lock:
+                    threading.Timer(5, lambda: _registry.clear()).start()
+        """, relname="iterative_cleaner_tpu/obs/fixture.py")
+        findings = self._run(sf)
+        assert len(findings) == 1
+        assert "outside its declared lock" in findings[0].message
+
+    def test_lock_taken_inside_deferred_body_still_counts(self, tmp_path):
+        """The converse: a callback that takes the lock itself IS guarded
+        — the deferred-scope boundary stops the ascent, it does not wipe
+        locks acquired within the nested body."""
+        sf = _sf(tmp_path, """\
+            import threading
+
+            _lock = threading.Lock()
+            _registry = {}  # ict: guarded-by(_lock)
+
+            def schedule():
+                def _cb():
+                    with _lock:
+                        _registry.clear()
+                threading.Timer(5, _cb).start()
+        """, relname="iterative_cleaner_tpu/obs/fixture.py")
+        assert self._run(sf) == []
+
+    def test_lazy_global_without_module_assignment_cataloged(self, tmp_path):
+        """A name that exists ONLY via `global` rebinding in a function
+        (no module-level spelling) is still shared state and must be
+        flagged (review regression: it was silently dropped)."""
+        sf = _sf(tmp_path, """\
+            import threading
+
+            _lock = threading.Lock()
+
+            def get_cache():
+                global _cache
+                _cache = {}
+                return _cache
+        """, relname="iterative_cleaner_tpu/obs/fixture.py")
+        findings = self._run(sf)
+        assert len(findings) == 1
+        assert "_cache" in findings[0].message
+        # The anchor (and the annotation site) is the rebinding def line.
+        assert findings[0].line == 5
+
+    def test_none_escape_with_reason(self, tmp_path):
+        sf = _sf(tmp_path, """\
+            _cache = {}  # ict: guarded-by(none: idempotent memo)
+
+            def note(k):
+                _cache[k] = 1
+        """, relname="iterative_cleaner_tpu/obs/fixture.py")
+        assert self._run(sf) == []
+
+    def test_unknown_lock_name_flagged(self, tmp_path):
+        sf = _sf(tmp_path, """\
+            _registry = {}  # ict: guarded-by(_no_such_lock)
+
+            def add(k, v):
+                _registry[k] = v
+        """, relname="iterative_cleaner_tpu/obs/fixture.py")
+        findings = self._run(sf)
+        assert len(findings) == 1 and "unknown lock" in findings[0].message
+
+    def test_none_prefixed_typo_is_not_the_escape(self, tmp_path):
+        """'guarded-by(nonexistent_lock)' must NOT read as the 'none:'
+        lock-free escape (review regression)."""
+        sf = _sf(tmp_path, """\
+            _registry = {}  # ict: guarded-by(nonexistent_lock)
+
+            def add(k, v):
+                _registry[k] = v
+        """, relname="iterative_cleaner_tpu/obs/fixture.py")
+        findings = self._run(sf)
+        assert len(findings) == 1 and "unknown lock" in findings[0].message
+
+    def test_annassign_global_cataloged(self, tmp_path):
+        """Annotated module globals (`_x: str | None = None`) rebound via
+        `global` are shared state too (review regression)."""
+        sf = _sf(tmp_path, """\
+            _path: str | None = None
+
+            def set_a(p):
+                global _path
+                _path = p
+
+            def set_b(p):
+                global _path
+                _path = p
+        """, relname="iterative_cleaner_tpu/obs/fixture.py")
+        findings = self._run(sf)
+        assert len(findings) == 1 and "_path" in findings[0].message
+
+    def test_lazy_init_attr_flagged(self, tmp_path):
+        """Attrs never assigned in __init__ must not escape the
+        multi-writer rule (review regression)."""
+        sf = _sf(tmp_path, """\
+            import threading
+
+            class Svc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def open(self):
+                    self._late = {}
+
+                def close(self):
+                    self._late = None
+        """, relname="iterative_cleaner_tpu/service/fixture.py")
+        findings = self._run(sf)
+        assert len(findings) == 1
+        assert "Svc._late" in findings[0].message
+        assert "no __init__ assignment" in findings[0].message
+
+    def test_multiwriter_class_attr_flagged(self, tmp_path):
+        sf = _sf(tmp_path, """\
+            import threading
+
+            class Svc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.mode = "a"
+
+                def demote(self):
+                    self.mode = "b"
+
+                def restore(self):
+                    self.mode = "a"
+        """, relname="iterative_cleaner_tpu/service/fixture.py")
+        findings = self._run(sf)
+        assert len(findings) == 1
+        assert "Svc.mode" in findings[0].message
+
+    def test_single_writer_attr_not_flagged(self, tmp_path):
+        sf = _sf(tmp_path, """\
+            import threading
+
+            class Svc:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.port = 0
+
+                def start(self):
+                    self.port = 8750
+        """, relname="iterative_cleaner_tpu/service/fixture.py")
+        assert self._run(sf) == []
+
+    def test_module_constant_list_not_flagged(self, tmp_path):
+        sf = _sf(tmp_path, '__all__ = ["a", "b"]\n',
+                 relname="iterative_cleaner_tpu/obs/fixture.py")
+        assert self._run(sf) == []
+
+
+# --- ICT008 lock-order inversion (the seeded fixture) ---
+
+
+class TestLockOrder:
+    def _run(self, *sfs):
+        from iterative_cleaner_tpu.analysis.races import run_race_rules
+
+        return [f for f in run_race_rules(list(sfs))
+                if f.rule == "ICT008/lock-order"]
+
+    def test_seeded_inversion_caught(self, tmp_path):
+        sf = _sf(tmp_path, """\
+            import threading
+
+            _lock_a = threading.Lock()
+            _lock_b = threading.Lock()
+
+            def forward():
+                with _lock_a:
+                    with _lock_b:
+                        pass
+
+            def backward():
+                with _lock_b:
+                    with _lock_a:
+                        pass
+        """, relname="iterative_cleaner_tpu/service/fixture.py")
+        findings = self._run(sf)
+        assert len(findings) >= 1
+        assert "lock-order inversion" in findings[0].message
+
+    def test_inversion_via_call_chain_caught(self, tmp_path):
+        """The edge that lexical nesting alone misses: backward() holds B
+        and CALLS a helper that takes A."""
+        sf = _sf(tmp_path, """\
+            import threading
+
+            _lock_a = threading.Lock()
+            _lock_b = threading.Lock()
+
+            def take_a():
+                with _lock_a:
+                    pass
+
+            def forward():
+                with _lock_a:
+                    with _lock_b:
+                        pass
+
+            def backward():
+                with _lock_b:
+                    take_a()
+        """, relname="iterative_cleaner_tpu/service/fixture.py")
+        assert len(self._run(sf)) >= 1
+
+    def test_recursive_call_cycle_does_not_hide_edges(self, tmp_path):
+        """A call cycle must not memoize a truncated lock set and hide
+        the inversion reachable through it (review regression)."""
+        sf = _sf(tmp_path, """\
+            import threading
+
+            _lock_a = threading.Lock()
+            _lock_b = threading.Lock()
+
+            def rec_a():
+                with _lock_a:
+                    pass
+                rec_b()
+
+            def rec_b():
+                with _lock_b:
+                    pass
+                rec_a()
+
+            def forward():
+                with _lock_a:
+                    with _lock_b:
+                        pass
+
+            def backward():
+                with _lock_b:
+                    rec_a()
+        """, relname="iterative_cleaner_tpu/service/fixture.py")
+        assert len(self._run(sf)) >= 1
+
+    def test_consistent_order_clean(self, tmp_path):
+        sf = _sf(tmp_path, """\
+            import threading
+
+            _lock_a = threading.Lock()
+            _lock_b = threading.Lock()
+
+            def one():
+                with _lock_a:
+                    with _lock_b:
+                        pass
+
+            def two():
+                with _lock_a:
+                    with _lock_b:
+                        pass
+        """, relname="iterative_cleaner_tpu/service/fixture.py")
+        assert self._run(sf) == []
+
+
+# --- the tree itself is clean (the CI gate, in-process) ---
+
+
+class TestTreeClean:
+    def test_source_and_race_layers_clean_on_tree(self):
+        from iterative_cleaner_tpu.analysis.races import run_race_rules
+        from iterative_cleaner_tpu.analysis.rules import run_source_rules
+
+        files = [load_source_file(REPO_ROOT, rel)
+                 for rel in collect_project_files(REPO_ROOT)]
+        findings = run_source_rules(files) + run_race_rules(files)
+        baseline = load_baseline(
+            os.path.join(REPO_ROOT, "tools", "ict_lint_baseline.json"))
+        fresh, _ = split_baselined(findings, baseline)
+        assert fresh == [], "\n" + "\n".join(f.render() for f in fresh)
+
+    def test_baseline_entries_all_have_notes(self):
+        path = os.path.join(REPO_ROOT, "tools", "ict_lint_baseline.json")
+        with open(path) as fh:
+            data = json.load(fh)
+        for entry in data.get("findings", []):
+            assert entry.get("note"), f"baseline entry without a note: {entry}"
+
+    def test_cli_exit_codes(self, tmp_path):
+        import subprocess
+        import sys
+
+        # Clean tree -> rc 0 (offline layers; the contracts layer is the
+        # jaxpr test below + CI).
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "ict_lint.py"),
+             "--source", "--races", "-q"],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        # A seeded violation -> rc 1.
+        bad = tmp_path / "bad_fixture.py"
+        bad.write_text("import jax\n\ndef f():\n    return jax.devices()\n")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools", "ict_lint.py"),
+             "--source", str(bad), "-q"],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 1
+        assert "ICT001/device-init" in proc.stdout
+
+
+# --- ICT009: the jaxpr/HLO contract checker on all four routes ---
+
+
+class TestRouteContracts:
+    def test_all_four_routes_pass(self):
+        from iterative_cleaner_tpu.analysis import contracts
+
+        findings = contracts.check_routes()
+        assert findings == [], "\n" + "\n".join(
+            f.render() for f in findings)
+
+    def test_route_coverage_is_total(self):
+        """Every route named in the donation ledger is actually traced —
+        the checker must fail loudly if a route is dropped from the
+        lowering list rather than silently passing."""
+        from iterative_cleaner_tpu.analysis import contracts
+
+        routes = {r for r, *_ in contracts._route_lowerings()}
+        assert routes == set(contracts.ROUTE_DONATIONS)
+        assert routes == {"stepwise", "fused", "chunked", "sharded"}
+
+    def test_checker_catches_seeded_callback(self):
+        import jax
+        import numpy as np
+
+        from iterative_cleaner_tpu.analysis.contracts import _check_jaxpr
+
+        def bad(x):
+            return jax.pure_callback(
+                lambda v: np.asarray(v),
+                jax.ShapeDtypeStruct((4,), np.float32), x)
+
+        closed = jax.make_jaxpr(jax.jit(bad))(
+            jax.ShapeDtypeStruct((4,), np.float32))
+        findings = _check_jaxpr("fixture", "cb", closed)
+        assert len(findings) == 1
+        assert "host-callback" in findings[0].message
+
+    def test_checker_catches_seeded_donation_drift(self):
+        import jax
+        import numpy as np
+
+        from iterative_cleaner_tpu.analysis.contracts import _count_donations
+
+        donated = jax.jit(lambda x: x + 1, donate_argnums=(0,)).lower(
+            jax.ShapeDtypeStruct((8,), np.float32))
+        plain = jax.jit(lambda x: x + 1).lower(
+            jax.ShapeDtypeStruct((8,), np.float32))
+        assert _count_donations(donated) >= 1
+        assert _count_donations(plain) == 0
+
+    def test_contract_fingerprints_distinguish_violation_kinds(self):
+        """Baselining one violation class for a route must not suppress a
+        different future violation at the same route/label (review
+        regression: all ICT009 findings shared one fingerprint)."""
+        from iterative_cleaner_tpu.analysis.contracts import _finding
+
+        kinds = ("callback", "dtype", "donation")
+        prints = {_finding("fused", "fused_clean", k, "m").fingerprint
+                  for k in kinds}
+        assert len(prints) == len(kinds)
+
+    def test_checker_catches_seeded_f64(self):
+        import jax
+        import numpy as np
+
+        from iterative_cleaner_tpu.analysis.contracts import _check_jaxpr
+
+        jax.config.update("jax_enable_x64", True)
+        try:
+            closed = jax.make_jaxpr(
+                lambda x: x.astype(np.float64).sum())(
+                    jax.ShapeDtypeStruct((4,), np.float32))
+        finally:
+            jax.config.update("jax_enable_x64", False)
+        findings = _check_jaxpr("fixture", "f64", closed)
+        assert len(findings) == 1
+        assert "64-bit" in findings[0].message
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -50,7 +50,7 @@ def main() -> int:
     from iterative_cleaner_tpu.parallel.mesh import make_mesh
     from iterative_cleaner_tpu.parallel.sharded import sharded_clean_single
 
-    mesh = make_mesh(8, devices=jax.devices("cpu"))
+    mesh = make_mesh(8, devices=jax.devices("cpu"))  # ict: backend-init-ok(cpu platform only; cannot wedge)
     failures = []
     for k in range(n):
         if k and k % 20 == 0:

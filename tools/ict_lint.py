@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""ict-lint: the invariant-aware static analysis suite's CLI.
+
+Layers (docs/ANALYSIS.md):
+
+- ``--source``     AST lint rules (ICT000-ICT006) over the package,
+                   tools/, bench.py — offline, no jax import;
+- ``--races``      the service//obs/ static race detector
+                   (ICT007 guarded-by, ICT008 lock-order) — offline;
+- ``--contracts``  the jaxpr/HLO route contract checker (ICT009) —
+                   imports jax, pins the CPU backend first;
+- ``--all``        everything (the CI gate:
+                   ``python tools/ict_lint.py --all``).
+
+Default with no layer flag: source + races (the fast offline pair).
+
+Exit status: 0 when every finding is baselined (tools/
+ict_lint_baseline.json), 1 otherwise, 2 on usage errors.  ``--fix``
+applies mechanical remedies (today: appending a ``guarded-by``
+annotation when every observed write already sits under one consistent
+lock) and re-reports; ``--write-baseline`` snapshots current findings —
+every entry then needs a hand-written justification to survive review.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ict-lint",
+        description="invariant-aware static analysis "
+                    "(lint / race detector / route contracts)")
+    p.add_argument("paths", nargs="*",
+                   help="restrict the source/race layers to these files "
+                        "(default: the whole project)")
+    p.add_argument("--all", action="store_true",
+                   help="run every layer (source + races + contracts)")
+    p.add_argument("--source", action="store_true",
+                   help="AST source rules (ICT000-ICT006)")
+    p.add_argument("--races", action="store_true",
+                   help="service//obs/ race detector (ICT007, ICT008)")
+    p.add_argument("--contracts", action="store_true",
+                   help="jaxpr/HLO route contracts (ICT009; imports jax, "
+                        "pins JAX_PLATFORMS=cpu unless ICT_TEST_TPU=1)")
+    p.add_argument("--fix", action="store_true",
+                   help="apply mechanical remedies, then re-run")
+    p.add_argument("--baseline",
+                   default=os.path.join(REPO_ROOT, "tools",
+                                        "ict_lint_baseline.json"),
+                   help="baseline suppression file (default: "
+                        "tools/ict_lint_baseline.json)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="snapshot current findings into the baseline")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="findings only, no summary chatter")
+    return p
+
+
+def select_layers(args) -> dict[str, bool]:
+    """The ONE place the layer-selection rule lives (default with no
+    layer flag: the fast offline pair)."""
+    return {
+        "source": args.source or args.all
+        or not (args.races or args.contracts),
+        "races": args.races or args.all
+        or not (args.source or args.contracts),
+        "contracts": args.contracts or args.all,
+    }
+
+
+def gather_findings(args, root: str, layers: dict[str, bool]):
+    from iterative_cleaner_tpu.analysis.engine import (
+        collect_project_files,
+        load_source_file,
+    )
+
+    findings = []
+    if layers["source"] or layers["races"]:
+        relpaths = collect_project_files(root, args.paths or None)
+        files = [load_source_file(root, rel) for rel in relpaths]
+        if layers["source"]:
+            from iterative_cleaner_tpu.analysis.rules import run_source_rules
+
+            findings.extend(run_source_rules(files))
+        if layers["races"]:
+            from iterative_cleaner_tpu.analysis.races import run_race_rules
+
+            findings.extend(run_race_rules(files))
+    if layers["contracts"]:
+        from iterative_cleaner_tpu.analysis.contracts import (
+            check_routes,
+            pin_cpu_for_contracts,
+        )
+
+        pin_cpu_for_contracts()
+        findings.extend(check_routes())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    root = REPO_ROOT
+
+    from iterative_cleaner_tpu.analysis.engine import (
+        apply_fixes,
+        load_baseline,
+        split_baselined,
+        write_baseline,
+    )
+
+    layers = select_layers(args)
+    findings = gather_findings(args, root, layers)
+    if args.fix:
+        n = apply_fixes(root, findings)
+        if n and not args.quiet:
+            print(f"ict-lint: --fix annotated {n} line(s); re-checking",
+                  file=sys.stderr)
+        if n:
+            # Annotation fixes can only change source/race results; carry
+            # the first pass's contract findings forward instead of
+            # re-tracing every route (seconds of jax work for nothing).
+            contract_findings = [f for f in findings
+                                 if f.rule.startswith("ICT009")]
+            findings = gather_findings(
+                args, root, {**layers, "contracts": False})
+            findings.extend(contract_findings)
+            findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    if args.write_baseline:
+        write_baseline(args.baseline, findings)
+        if not args.quiet:
+            print(f"ict-lint: wrote {len(findings)} finding(s) to "
+                  f"{args.baseline}", file=sys.stderr)
+        return 0
+
+    baseline = load_baseline(args.baseline)
+    fresh, suppressed = split_baselined(findings, baseline)
+    for f in fresh:
+        print(f.render())
+    if not args.quiet:
+        ran = [name for name, on in layers.items() if on]
+        print(f"ict-lint: {len(fresh)} finding(s) "
+              f"({len(suppressed)} baselined) across "
+              f"{'+'.join(ran)}", file=sys.stderr)
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,7 +107,7 @@ def replay(bundle_dir: str) -> dict:
             sharded_clean_single,
         )
 
-        mesh = make_mesh(8, devices=jax.devices("cpu"))
+        mesh = make_mesh(8, devices=jax.devices("cpu"))  # ict: backend-init-ok(cpu platform only; cannot wedge)
         _t, w_sh, _loops, _done = sharded_clean_single(D, w0, live_cfg, mesh)
         w_sh, _ = finalize_weights(np.asarray(w_sh), live_cfg)
         live_diffs["sharded"] = int(np.sum(w_sh != oracle_w))
