@@ -24,9 +24,10 @@ real compile beyond lowering) and three contracts are asserted:
 - **donation ledger** — buffer-donation annotations silently vanish when
   a wrapper re-jits or an alias is dropped at lowering; the lowered
   StableHLO's donation markers must match :data:`ROUTE_DONATIONS`
-  exactly.  Today every route declares 0 (donation is a planned ingest
-  optimisation, ROADMAP item 2); landing one means updating the ledger
-  in the same PR — that is the contract doing its job.
+  exactly.  The ingest PR registered the first intentional donations
+  (stepwise: 1, chunked: 3 — see the ledger's own comment for what each
+  buffer is and why it is safe); changing a donation means updating the
+  ledger in the same PR — that is the contract doing its job.
 
 Run via ``tools/ict_lint.py --contracts`` (CI: ``JAX_PLATFORMS=cpu``).
 Imports jax lazily so the source/race layers stay import-light; callers
@@ -50,10 +51,23 @@ TINY_MAX_ITER = 3
 #: bump its route here — the checker fails on any mismatch, in BOTH
 #: directions (a vanished donation is a silent perf regression; an
 #: unexpected one is a correctness hazard for callers that reuse inputs).
+#:
+#: Registered donations (the ingest PR; all internal-only buffers — D, w0,
+#: valid and every other caller-owned input stay undonated):
+#:
+#: - stepwise: 1 — ``advance_template`` donates its carried template
+#:   (T_prev aliases the equally-shaped output; the carry is dead the
+#:   moment its successor exists).
+#: - chunked: 3 — ``_sparse_template_update`` donates the carried template
+#:   (1), and ``_finish`` donates the freshly-concatenated d_std / d_mean
+#:   maps, which alias the (test, new_w) outputs (2).
+#: - fused / sharded: 0 by design — their array inputs are caller-owned
+#:   and reused across calls (bench re-dispatches on the same device cube),
+#:   so donation there would be a correctness hazard, not an optimisation.
 ROUTE_DONATIONS = {
-    "stepwise": 0,
+    "stepwise": 1,
     "fused": 0,
-    "chunked": 0,
+    "chunked": 3,
     "sharded": 0,
 }
 

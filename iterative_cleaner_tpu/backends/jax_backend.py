@@ -27,10 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from iterative_cleaner_tpu.config import CleanConfig
-from iterative_cleaner_tpu.ops.stats import (
-    comprehensive_stats,
-    comprehensive_stats_from_moments,
-)
+from iterative_cleaner_tpu.ops.stats import comprehensive_stats
 from iterative_cleaner_tpu.ops.template import build_template, fit_and_subtract
 
 
@@ -42,24 +39,32 @@ def _step_from_template(D, w0, valid, template, chanthresh, subintthresh, *,
     if use_pallas:
         from iterative_cleaner_tpu.ops.pallas_kernels import (
             fused_fit_moments,
-            pallas_route_ok,
+            pallas_route_status,
             use_interpret,
         )
 
-        if not pallas_route_ok(D.shape[-1]):
+        route_ok, route_why = pallas_route_status(D.shape[-1])
+        if not route_ok:
             import warnings
 
             warnings.warn(
-                "pallas=True but the Pallas route is not viable here "
-                "(non-TPU platform or nbin too large for VMEM); using the "
-                "XLA route", stacklevel=2)
+                f"pallas=True but the Pallas route is not viable here "
+                f"({route_why}); using the XLA route", stacklevel=2)
             use_pallas = False
     if use_pallas:
-        centred, mean, std, ptp = fused_fit_moments(
-            D, template, w0, pulse_region=pulse_region,
+        # valid passed in: the kernel emits scaler-ready (filled) maps, so
+        # the XLA tail is exactly the FFT diagnostic + robust scalers.
+        from iterative_cleaner_tpu.ops.stats import (
+            fft_diagnostic,
+            scale_and_combine,
+        )
+
+        centred, d_mean, d_std, d_ptp = fused_fit_moments(
+            D, template, w0, valid, pulse_region=pulse_region,
             interpret=use_interpret())
-        test = comprehensive_stats_from_moments(
-            centred, mean, std, ptp, valid, chanthresh, subintthresh)
+        test = scale_and_combine(
+            d_std, d_mean, d_ptp, fft_diagnostic(centred), valid,
+            chanthresh, subintthresh)
         resid = None
     else:
         _amp, resid = fit_and_subtract(D, template, pulse_region)
@@ -148,7 +153,12 @@ def _incremental_template(D, T_prev, w_prev, new_w):
 
 
 dense_template = jax.jit(build_template)
-advance_template = jax.jit(_incremental_template)
+# T_prev is donated (registered in analysis/contracts.ROUTE_DONATIONS —
+# ICT009 fails if the alias vanishes at lowering): the carried template is
+# dead the instant its successor exists, the (nbin,) output aliases it, and
+# every caller (JaxCleaner.step, precompile_for) reassigns the carry
+# immediately.  D / the weight maps stay caller-owned and undonated.
+advance_template = jax.jit(_incremental_template, donate_argnums=(1,))
 
 
 def precompile_for(shape, cfg, want_residual: bool = False) -> None:
@@ -191,10 +201,13 @@ def precompile_for(shape, cfg, want_residual: bool = False) -> None:
         np.asarray(out[6][: int(out[4]) + 1])
     elif incremental:
         np.asarray(dense_template(D, w))
-        np.asarray(advance_template(D, t, w, w))
         out = step_from_template(
             D, w, v, t, 5.0, 5.0, pulse_region=pr, use_pallas=use_pallas)
         np.asarray(out[1])
+        # LAST: advance_template donates its T_prev argument, so the dummy
+        # ``t`` is dead after this call — any warm that reads it must run
+        # before.
+        np.asarray(advance_template(D, t, w, w))
     else:
         out = clean_step(
             D, w, v, w, 5.0, 5.0, pulse_region=pr, use_pallas=use_pallas)
@@ -397,6 +410,10 @@ class JaxCleaner:
             else:
                 template = advance_template(
                     self._D, self._tmpl, self._tmpl_w, w_prev)
+            # Reassign the carry IMMEDIATELY: advance_template donated the
+            # old self._tmpl, so it must never be passed again (a failed
+            # step below must not leave a dead buffer in the carry).
+            self._tmpl, self._tmpl_w = template, w_prev
             test, new_w, resid = step_from_template(
                 self._D,
                 self._w0,
@@ -407,7 +424,6 @@ class JaxCleaner:
                 pulse_region=tuple(self.cfg.pulse_region),
                 use_pallas=self.cfg.pallas,
             )
-            self._tmpl, self._tmpl_w = template, w_prev
         self._residual = resid  # stays on device unless fetched
         return np.asarray(test), np.asarray(new_w)
 

@@ -1,0 +1,273 @@
+"""Double-buffered host->device block staging for the streaming routes.
+
+The chunked (>HBM) backend streams ``(block, nchan, nbin)`` subint slabs
+through the device.  Before this module, each pass ran
+
+    load block k -> dispatch kernels on block k -> sync block k-1 -> ...
+
+on ONE thread, so the host-side work of ``load`` (slicing the host cube,
+the dtype copy, and the device transfer enqueue -- on a slow link the
+transfer itself) serialized in front of every block's compute.  The stager
+here moves ``load`` onto a background thread with a credit protocol sized
+to the existing residency budget:
+
+- ``depth`` credits (default 2) bound how many device blocks may be live
+  at once; the consumer returns a credit only after it has *synced* the
+  compute that consumed the oldest block, so at steady state exactly two
+  blocks exist on device -- the current one computing and the next one
+  uploading -- which is the same 2-slab budget
+  ``autoshard.chunk_block_subints`` already sizes blocks for.
+- the consumer's only wait is ``queue.get`` on a block whose upload did
+  not finish hiding under the previous block's compute; the share of that
+  wait NOT absorbed by still-in-flight compute (the critical-path
+  ``stall``) is the pipeline's figure of demerit, exported as
+  ``ingest_stall`` next to the ``ingest_upload`` busy time so
+  ``overlap efficiency = 1 - stall/upload`` is computable from counters.
+
+Determinism: the stager changes WHEN bytes move, never their values or the
+order the consumer sees blocks in, so every mask stays bit-identical to the
+serial path (pinned by tests/test_ingest.py and the fuzz corpus's
+chunked-serial A/B mode).  ``ICT_INGEST_DEPTH=1`` reverts to the serial
+in-line path everywhere.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Callable, Iterable, Sequence
+
+#: Default staging depth: current block computing + next block uploading.
+#: Matches the 2-slab device-residency budget autoshard sizes blocks for;
+#: raising it buys nothing until uploads are faster than compute AND the
+#: block budget is re-derived.
+DEFAULT_DEPTH = 2
+
+_stats_lock = threading.Lock()
+_STATS = {
+    "blocks": 0,           # blocks staged through any stager
+    "serial_blocks": 0,    # of which on the serial (depth=1) path
+    "bytes": 0,            # device bytes staged
+    "upload_busy_s": 0.0,  # stager-thread time spent loading blocks
+    "wait_s": 0.0,         # raw consumer time blocked on a not-yet-ready
+                           # block (first-block pipeline fill excluded)
+    "stall_s": 0.0,        # the CRITICAL-PATH share of that wait: per
+                           # block, the get-wait minus the compute-sync
+                           # time that ran anyway right after it (a wait
+                           # fully absorbed by an in-flight compute costs
+                           # no wall clock); serial loads count entirely
+                           # — nothing hides an in-line load
+}
+
+
+def stream_depth() -> int:
+    """The staging depth (``ICT_INGEST_DEPTH``, default 2; 1 = serial)."""
+    try:
+        return max(1, int(os.environ.get("ICT_INGEST_DEPTH", DEFAULT_DEPTH)))
+    except ValueError:
+        return DEFAULT_DEPTH
+
+
+def stats_snapshot() -> dict:
+    """Cumulative pipeline counters + the derived overlap figures.
+
+    ``overlap_efficiency`` is the fraction of upload busy-time whose cost
+    was hidden under device compute: ``1 - stall/upload``, clamped to
+    [0, 1], where ``stall_s`` is the critical-path wait (see _STATS).  The
+    serial path scores 0 by construction (every in-line load is exposed
+    wall clock); a pipeline whose uploads always finished under the
+    previous block's compute scores 1."""
+    with _stats_lock:
+        s = dict(_STATS)
+    busy = s["upload_busy_s"]
+    s["overlap_efficiency"] = (
+        round(max(0.0, min(1.0, 1.0 - s["stall_s"] / busy)), 4)
+        if busy > 1e-9 else 0.0)
+    s["effective_gbps"] = (
+        round(s["bytes"] / 1e9 / busy, 4) if busy > 1e-9 else 0.0)
+    s["upload_busy_s"] = round(busy, 4)
+    s["wait_s"] = round(s["wait_s"], 4)
+    s["stall_s"] = round(s["stall_s"], 4)
+    return s
+
+
+def reset_stats() -> None:
+    """Zero the cumulative counters (bench sections measure deltas)."""
+    with _stats_lock:
+        _STATS.update(blocks=0, serial_blocks=0, bytes=0,
+                      upload_busy_s=0.0, wait_s=0.0, stall_s=0.0)
+
+
+def _note(blocks=0, serial=0, nbytes=0, upload_s=0.0, wait_s=0.0,
+          stall_s=0.0) -> None:
+    with _stats_lock:
+        _STATS["blocks"] += blocks
+        _STATS["serial_blocks"] += serial
+        _STATS["bytes"] += nbytes
+        _STATS["upload_busy_s"] += upload_s
+        _STATS["wait_s"] += wait_s
+        _STATS["stall_s"] += stall_s
+
+
+class _Failure:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException) -> None:
+        self.exc = exc
+
+
+class BlockStager:
+    """Iterate ``((lo, hi), device_block)`` with uploads staged ahead.
+
+    ``load(lo, hi)`` runs on the stager thread and must return the
+    device-dispatched block (e.g. ``jnp.asarray(host[lo:hi], dtype)``).
+    The CONSUMER drives the credit protocol: after it has synced the
+    compute that consumed a block, it calls :meth:`release` to let the
+    stager start the next upload.  :func:`stream_map` packages that
+    protocol correctly -- prefer it; iterating a stager directly without
+    releasing credits stalls the pipeline after ``depth`` blocks.
+
+    Per-instance counters (``upload_busy_s``, ``wait_s``, ``nbytes``,
+    ``blocks``) accumulate alongside the module-global ones.
+    """
+
+    def __init__(
+        self,
+        ranges: Iterable[tuple[int, int]],
+        load: Callable[[int, int], object],
+        depth: int | None = None,
+    ) -> None:
+        self.ranges: Sequence[tuple[int, int]] = list(ranges)
+        self._load = load
+        self.depth = stream_depth() if depth is None else max(1, int(depth))
+        self.upload_busy_s = 0.0
+        self.wait_s = 0.0
+        self.stall_s = 0.0
+        self.last_wait_s = 0.0  # this block's get-wait, read by stream_map
+        self.serial = False     # which path __iter__ took
+        self.nbytes = 0
+        self.blocks = 0
+        self._credits = threading.Semaphore(self.depth)
+        self._stop = threading.Event()
+
+    def release(self) -> None:
+        """Return one residency credit: the oldest staged block's consumer
+        is done (compute synced), so its device buffer is reclaimable and
+        the next upload may start."""
+        self._credits.release()
+
+    def _account(self, blk, dt: float, serial: bool) -> None:
+        nbytes = int(getattr(blk, "nbytes", 0))
+        self.upload_busy_s += dt
+        self.nbytes += nbytes
+        self.blocks += 1
+        _note(blocks=1, serial=int(serial), nbytes=nbytes, upload_s=dt)
+
+    def __iter__(self):
+        if self.depth == 1 or len(self.ranges) <= 1:
+            # Serial fallback: load in-line on the consumer thread --
+            # the pre-pipeline behavior, kept reachable for A/B parity
+            # (fuzz chunked-serial mode) and for hosts where a background
+            # thread is unwanted (ICT_INGEST_DEPTH=1).  Every in-line load
+            # is exposed wall clock, so it all counts as stall.
+            self.serial = True
+            for lo, hi in self.ranges:
+                t0 = time.perf_counter()
+                blk = self._load(lo, hi)
+                dt = time.perf_counter() - t0
+                self._account(blk, dt, serial=True)
+                self.stall_s += dt
+                self.last_wait_s = 0.0
+                _note(stall_s=dt)
+                yield (lo, hi), blk
+            return
+
+        q: queue.Queue = queue.Queue()  # bounded by the credit semaphore
+
+        def run() -> None:
+            try:
+                for lo, hi in self.ranges:
+                    self._credits.acquire()
+                    if self._stop.is_set():
+                        return
+                    t0 = time.perf_counter()
+                    blk = self._load(lo, hi)
+                    self._account(blk, time.perf_counter() - t0, serial=False)
+                    q.put(((lo, hi), blk))
+            except BaseException as exc:  # noqa: BLE001 — re-raised consumer-side
+                q.put(_Failure(exc))
+
+        th = threading.Thread(target=run, daemon=True, name="ict-ingest-stage")
+        th.start()
+        try:
+            for i in range(len(self.ranges)):
+                t0 = time.perf_counter()
+                item = q.get()
+                dt = time.perf_counter() - t0
+                if isinstance(item, _Failure):
+                    raise item.exc
+                if i:  # the first block's fill has nothing to overlap with
+                    self.wait_s += dt
+                    self.last_wait_s = dt
+                    _note(wait_s=dt)
+                else:
+                    self.last_wait_s = 0.0
+                yield item
+        finally:
+            # Consumer done or dying mid-stream: unblock the stager thread
+            # (it re-checks _stop after every credit) and let it exit.
+            self._stop.set()
+            self._credits.release()
+
+
+def stream_map(
+    ranges: Iterable[tuple[int, int]],
+    load: Callable[[int, int], object],
+    compute: Callable[[int, int, object], object],
+    sync: Callable[[object], None],
+    depth: int | None = None,
+) -> list:
+    """Run ``compute`` over staged blocks with the full overlap protocol.
+
+    For each range, ``compute(lo, hi, block)`` dispatches the device work
+    (asynchronously, as jax does); ``sync(prev_out)`` is called on each
+    previous output before the stager is allowed to stage another block --
+    that single ordering rule is what bounds device residency to
+    ``depth`` blocks while the next upload hides under the current
+    compute.  Returns the list of compute outputs, in order.
+    """
+    from iterative_cleaner_tpu.obs import tracing
+
+    unset = object()  # sentinel: a compute() returning None is still synced
+    outs: list = []
+    stager = BlockStager(ranges, load, depth=depth)
+    prev = unset
+    for (lo, hi), blk in stager:
+        get_wait = stager.last_wait_s
+        out = compute(lo, hi, blk)
+        if prev is not unset:
+            t0 = time.perf_counter()
+            sync(prev)
+            sync_s = time.perf_counter() - t0
+            stager.release()
+            if not stager.serial:
+                # Critical-path accounting: this block's get-wait ran while
+                # the previous block's compute was still in flight (the
+                # sync right after proves how much compute was left); only
+                # the surplus beyond that compute cost wall clock.
+                stall = max(0.0, get_wait - sync_s)
+                if stall:
+                    stager.stall_s += stall
+                    _note(stall_s=stall)
+        outs.append(out)
+        prev = out
+    if prev is not unset:
+        sync(prev)
+    # One phase observation per pass (not per block): the daemon /metrics
+    # view of the same counters the module-global snapshot feeds.
+    tracing.observe_phase("ingest_upload", stager.upload_busy_s)
+    if stager.stall_s:
+        tracing.observe_phase("ingest_stall", stager.stall_s)
+    return outs

@@ -5,21 +5,33 @@ loop #2 (reference ``iterative_cleaner.py:258-287`` and ``:205-208``) in ONE
 pass over the cube's HBM: per (subint, channel) profile it computes the
 closed-form template amplitude ``amp = <t, p> / <t, t>`` (§8.L7), the
 pulse-region-scaled residual ``amp·t − p`` (:276, :279-282), the weight
-pre-scaling (:290-296), and the mean / std / ptp diagnostics (:205-208),
-emitting only the *centred* weighted residual (which the XLA FFT diagnostic
-consumes) and three (nsub, nchan) moment maps.
+pre-scaling (:290-296), the mean / std / ptp diagnostics (:205-208), and —
+when the caller passes ``valid`` — the numpy.ma fill semantics of
+``ops.stats.fill_moments``, emitting the *centred* weighted residual (which
+the XLA FFT diagnostic consumes) and three scaler-ready (nsub, nchan) moment
+maps.  With the fills fused, the whole stats phase outside the FFT is one
+HBM pass: the XLA tail is exactly ``fft_diagnostic`` + the sort-based robust
+scalers.
 
 Why this is the right fusion: the un-fused XLA path materialises the residual
 cube, the weighted cube, and the centred cube in HBM — ~5 cube-sized HBM
 transfers per iteration.  This kernel reads D once and writes one cube; the
-VPU does all the per-profile math while each block sits in VMEM.  The FFT
-diagnostic stays in XLA (TPU FFT is an XLA primitive; Pallas has none), as do
-the sort-based robust scalers (nsub×nchan maps — three orders of magnitude
-smaller than the cube, not worth kernel treatment until profiles say so).
+VPU does all the per-profile math while each block sits in VMEM.  The grid
+is declared fully ``parallel`` (profiles are independent), so Mosaic may
+pipeline/reorder blocks freely.  The FFT diagnostic stays in XLA (TPU FFT is
+an XLA primitive; Pallas has none), as do the sort-based robust scalers
+(nsub×nchan maps — three orders of magnitude smaller than the cube, not
+worth kernel treatment until profiles say so).
 
 Semantics match ``ops.template.fit_and_subtract`` + the moment part of
-``ops.stats.diagnostics`` bit-for-bit up to f32 reduction order; parity is
-pinned by ``tests/test_pallas.py`` (interpret mode on CPU, compiled on TPU).
+``ops.stats.diagnostics`` (+ ``fill_moments`` when ``valid`` is given)
+bit-for-bit up to f32 reduction order; parity is pinned by
+``tests/test_pallas.py`` (interpret mode on CPU, compiled on TPU).
+
+Route viability is a *reasoned* decision now: :func:`pallas_route_status`
+returns (ok, why) — platform, bin-axis tiling, and VMEM accounting — and
+every caller (clean_step, the chunked backend, bench.py's ``pallas``
+section) surfaces the reason instead of a bare bool.
 """
 
 from __future__ import annotations
@@ -36,6 +48,7 @@ from iterative_cleaner_tpu.config import (
     pulse_region_active,
     pulse_region_bin_scale,
 )
+from iterative_cleaner_tpu.ops.stats import MA_FILL
 
 _PREC = jax.lax.Precision.HIGHEST
 
@@ -48,6 +61,18 @@ _SUBLANE = 8
 _LANE = 128
 _BLOCK_BUDGET = 1 << 18  # profiles*bins per block ≈ 1 MB f32
 
+#: VMEM working-set model for viability reporting: the D block in, the
+#: centred block out, each double-buffered by the Mosaic pipeline, plus
+#: roughly one block of kernel temporaries — measured against the ~16 MB
+#: per-core VMEM.  Kept as a *model* (not a Mosaic query) so the
+#: viability decision is deterministic and explainable offline.
+_VMEM_BYTES = 16 << 20
+_VMEM_BLOCK_FACTOR = 5  # (in + out) × 2 (double-buffer) + ~1 temporaries
+
+# TPUCompilerParams appeared mid-0.4.x; older jax within the declared
+# floor simply skips the dimension-semantics hint.
+_COMPILER_PARAMS = getattr(pltpu, "TPUCompilerParams", None)
+
 
 def _block_shape(nb_p: int) -> tuple[int, int]:
     """Pick the (BS, BC) profile tile for a padded bin count."""
@@ -56,10 +81,12 @@ def _block_shape(nb_p: int) -> tuple[int, int]:
     return bs, max(bc, _SUBLANE)
 
 
-def _fused_kernel(tt_ref, D_ref, t_ref, bs_ref, w_ref,
+def _fused_kernel(tt_ref, D_ref, t_ref, bs_ref, w_ref, v_ref,
                   centred_ref, mean_ref, std_ref, ptp_ref,
-                  *, nbin: int, nb_p: int):
-    """One (BS, BC, NB) block: fit, subtract, weight, centre, moments."""
+                  *, nbin: int, nb_p: int, fill: bool):
+    """One (BS, BC, NB) block: fit, subtract, weight, centre, moments, and
+    (``fill``) the numpy.ma valid-fills — the whole per-profile stats chain
+    in one VMEM residency."""
     # The (nsub, nchan) maps travel as (BS, BC, 1) blocks: Pallas TPU wants
     # the last two block dims (8, 128)-tiled OR equal to the array dims, and
     # a (BS, BC) block with the VMEM-budget-sized BC < 128 satisfies neither.
@@ -97,23 +124,37 @@ def _fused_kernel(tt_ref, D_ref, t_ref, bs_ref, w_ref,
         var = jnp.sum(jnp.where(live, c * c, 0.0), axis=-1) / nbin
         ptp = (jnp.max(jnp.where(live, wr, -jnp.inf), axis=-1)
                - jnp.min(jnp.where(live, wr, jnp.inf), axis=-1))
+    std = jnp.sqrt(var)
+
+    if fill:
+        # ops.stats.fill_moments fused in: 0.0 raw data at fully-masked
+        # profiles for the masked mean/std reductions, the MaskedArray fill
+        # value for ptp — elementwise selects, bit-identical to the XLA
+        # tail they replace.
+        valid = v_ref[:, :, 0] != 0
+        mean = jnp.where(valid, mean, 0.0)
+        std = jnp.where(valid, std, 0.0)
+        ptp = jnp.where(valid, ptp, MA_FILL)
 
     centred_ref[:] = c
     mean_ref[:] = mean[..., None]
-    std_ref[:] = jnp.sqrt(var)[..., None]
+    std_ref[:] = std[..., None]
     ptp_ref[:] = ptp[..., None]
 
 
 @functools.partial(jax.jit, static_argnames=("pulse_region", "interpret"))
-def fused_fit_moments(D, template, w0, *, pulse_region=(0.0, 0.0, 1.0),
-                      interpret=False):
+def fused_fit_moments(D, template, w0, valid=None, *,
+                      pulse_region=(0.0, 0.0, 1.0), interpret=False):
     """Fit + subtract + weight + centre + moment diagnostics, one HBM pass.
 
     D: (nsub, nchan, nbin) f32; template: (nbin,); w0: (nsub, nchan).
     Returns (centred, mean, std, ptp): the centred weighted-residual cube
-    (input to the mask-blind FFT diagnostic, §8.L1) and the three raw moment
-    maps (pre valid-fill — ``ops.stats.diagnostics`` fill semantics are
-    applied by the caller).
+    (input to the mask-blind FFT diagnostic, §8.L1) and the three moment
+    maps.  With ``valid`` (= w0 != 0) the maps come back scaler-ready —
+    ``ops.stats.fill_moments`` is fused into the kernel (0.0 at masked
+    profiles for mean/std, the 1e20 MaskedArray fill for ptp) so the XLA
+    tail is just the FFT diagnostic + robust scalers; with ``valid=None``
+    the maps are raw (pre-fill), the original contract.
     """
     nsub, nchan, nbin = D.shape
     dtype = D.dtype
@@ -139,6 +180,9 @@ def fused_fit_moments(D, template, w0, *, pulse_region=(0.0, 0.0, 1.0),
     tp_ = jnp.pad(template.astype(dtype), (0, nb_p - nbin))[None, :]
     bsc = jnp.pad(jnp.asarray(bin_scale, dtype), (0, nb_p - nbin))[None, :]
     wp = jnp.pad(w0.astype(dtype), ((0, nsub_p - nsub), (0, nchan_p - nchan)))
+    fill = valid is not None
+    vmask = wp if not fill else jnp.pad(
+        valid.astype(dtype), ((0, nsub_p - nsub), (0, nchan_p - nchan)))
 
     grid = (nsub_p // bs, nchan_p // bc)
     prof_spec = pl.BlockSpec((bs, bc, 1), lambda i, j: (i, j, 0),
@@ -148,8 +192,14 @@ def fused_fit_moments(D, template, w0, *, pulse_region=(0.0, 0.0, 1.0),
     bin_spec = pl.BlockSpec((1, nb_p), lambda i, j: (0, 0),
                             memory_space=pltpu.VMEM)
 
+    kwargs = {}
+    if not interpret and _COMPILER_PARAMS is not None:
+        # Profiles are independent: a fully-parallel grid lets Mosaic
+        # pipeline block DMA against compute and reorder freely.
+        kwargs["compiler_params"] = _COMPILER_PARAMS(
+            dimension_semantics=("parallel", "parallel"))
     centred, mean, std, ptp = pl.pallas_call(
-        functools.partial(_fused_kernel, nbin=nbin, nb_p=nb_p),
+        functools.partial(_fused_kernel, nbin=nbin, nb_p=nb_p, fill=fill),
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),   # tt (1,)
@@ -157,6 +207,7 @@ def fused_fit_moments(D, template, w0, *, pulse_region=(0.0, 0.0, 1.0),
             bin_spec,                                 # template
             bin_spec,                                 # bin_scale
             prof_spec,                                # w0 (S, C, 1)
+            prof_spec,                                # valid mask (S, C, 1)
         ],
         out_specs=[cube_spec, prof_spec, prof_spec, prof_spec],
         out_shape=[
@@ -166,7 +217,8 @@ def fused_fit_moments(D, template, w0, *, pulse_region=(0.0, 0.0, 1.0),
             jax.ShapeDtypeStruct((nsub_p, nchan_p, 1), dtype),
         ],
         interpret=interpret,
-    )(tt.reshape(1), Dp, tp_, bsc, wp[..., None])
+        **kwargs,
+    )(tt.reshape(1), Dp, tp_, bsc, wp[..., None], vmask[..., None])
 
     return (centred[:nsub, :nchan, :nbin], mean[:nsub, :nchan, 0],
             std[:nsub, :nchan, 0], ptp[:nsub, :nchan, 0])
@@ -191,23 +243,41 @@ def use_interpret() -> bool:
     return _platform() != "tpu"
 
 
-def pallas_route_ok(nbin: int) -> bool:
-    """Whether the Pallas route should be taken at all (trace-time check).
+def pallas_route_status(nbin: int) -> tuple[bool, str]:
+    """Whether the Pallas route should be taken, WITH the reason when not
+    (trace-time check; bench surfaces the string in ``pallas.skipped`` and
+    the runtime warnings quote it).
 
     - TPU: yes, provided the minimum block fits the VMEM budget (the bin
-      axis is never tiled, so a huge nbin can make even a (8, 8, nb_p) block
-      blow the ~16 MB VMEM with its temporaries).
+      axis is never tiled — mean/std are two-pass per profile, so tiling
+      bins would change the reduction structure the parity contract pins —
+      and a huge nbin can make even a (8, 8, nb_p) block blow the ~16 MB
+      VMEM with its temporaries).
     - CPU: yes — interpret mode, the test harness for the kernel body.
     - anything else (GPU): no — interpret mode there would be a silent
       orders-of-magnitude slowdown, not an optimisation.
     """
     platform = _platform()
     if platform == "cpu":
-        return True
+        return True, "cpu: interpret-mode kernel-body harness"
     if platform != "tpu":
-        return False
+        return False, (
+            f"platform {platform!r} has no Pallas TPU lowering; interpret "
+            "mode there would be a silent orders-of-magnitude slowdown")
     nb_p = -(-nbin // _LANE) * _LANE
     bs, bc = _block_shape(nb_p)
-    # The floored minimum block must still respect the budget the kernel's
-    # VMEM accounting was sized for (nbin <= 4096 in practice).
-    return bs * bc * nb_p <= _BLOCK_BUDGET
+    if bs * bc * nb_p > _BLOCK_BUDGET:
+        # The floored minimum block exceeds the budget the kernel's VMEM
+        # accounting was sized for (nbin <= 4096 in practice).
+        need_mb = (_VMEM_BLOCK_FACTOR * bs * bc * nb_p * 4) / (1 << 20)
+        return False, (
+            f"nbin={nbin}: the bin axis is never tiled and the minimum "
+            f"({bs}, {bc}, {nb_p}) block implies ~{need_mb:.0f} MB of VMEM "
+            f"working set (in+out, double-buffered, + temporaries) against "
+            f"the {_VMEM_BYTES >> 20} MB/core budget")
+    return True, f"tpu: ({bs}, {bc}, {nb_p}) blocks fit the VMEM budget"
+
+
+def pallas_route_ok(nbin: int) -> bool:
+    """Bare-bool view of :func:`pallas_route_status` (routing call sites)."""
+    return pallas_route_status(nbin)[0]

@@ -69,18 +69,6 @@ def fill_moments(mean, std, ptp, valid):
             jnp.where(valid, ptp, MA_FILL))
 
 
-def comprehensive_stats_from_moments(
-    centred, mean, std, ptp, valid, chanthresh: float, subintthresh: float
-) -> jnp.ndarray:
-    """The stats tail for the Pallas-fused path: the kernel already produced
-    the centred cube and raw moments (ops/pallas_kernels.py); only the XLA
-    FFT diagnostic, the fills, and the robust scalers remain."""
-    d_mean, d_std, d_ptp = fill_moments(mean, std, ptp, valid)
-    return scale_and_combine(
-        d_std, d_mean, d_ptp, fft_diagnostic(centred), valid,
-        chanthresh, subintthresh)
-
-
 def scale_masked(diag: jnp.ndarray, valid: jnp.ndarray, axis: int, thresh: float):
     """Type-A robust scaling along ``axis`` with numpy.ma leak semantics.
 

@@ -34,6 +34,15 @@ in-memory path so the semantics cannot drift:
 The cross-profile couplings (per-channel / per-subint robust scalers) run
 once on the assembled maps — three orders of magnitude smaller than the cube.
 
+Every streaming pass runs through the double-buffered upload pipeline
+(:mod:`..ingest.pipeline`): block k+1's host slice + dtype copy + device
+transfer proceed on a background stager thread while block k's kernels run,
+with device residency still bounded to two slabs by the pipeline's credit
+protocol (the same budget ``autoshard.chunk_block_subints`` sizes blocks
+for).  ``ICT_INGEST_DEPTH=1`` reverts to the serial in-line path; masks are
+bit-identical either way (the pipeline moves bytes earlier, never changes
+them or the block order).
+
 Cost model: 2 cube uploads for the FIRST iteration; from iteration 2 the
 template pass drops out whenever few enough profiles flipped
 (``cfg.incremental_template``, on by default): the backend carries the
@@ -59,16 +68,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.ingest.pipeline import stream_map
 from iterative_cleaner_tpu.ops.stats import diagnostics, scale_and_combine
 from iterative_cleaner_tpu.ops.template import build_template, fit_and_subtract
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def _sparse_template_update(tmpl, dvals, profs):
     """tmpl + sum_k dvals[k] * profs[k] — the flipped-profile correction.
     Inputs are padded host-side to the fixed INCREMENTAL_TEMPLATE_BUDGET
     rows (zero rows contribute nothing) so one executable serves every
-    iteration."""
+    iteration.  ``tmpl`` is donated (registered in
+    ``analysis/contracts.ROUTE_DONATIONS``): the carried template is dead
+    the moment its successor exists — ``_template_for`` reassigns the
+    carry on every call, on both the accept and the dense-fallback branch,
+    so the donated buffer is never re-read."""
     return tmpl + jnp.matmul(
         dvals, profs, precision=jax.lax.Precision.HIGHEST)
 
@@ -97,22 +111,27 @@ def _block_stats(Dblk, template, w0blk, validblk, *, pulse_region, want_resid):
 def _block_stats_pallas(Dblk, template, w0blk, validblk, *, pulse_region,
                         interpret):
     """The Pallas route for one block: the fused fit/weight/centre/moments
-    kernel (one HBM pass over the block — ops/pallas_kernels.py), then the
-    XLA FFT diagnostic and the numpy.ma fills."""
+    kernel with the numpy.ma valid-fills fused in (one HBM pass over the
+    block — ops/pallas_kernels.py), then the XLA FFT diagnostic."""
     from iterative_cleaner_tpu.ops.pallas_kernels import fused_fit_moments
-    from iterative_cleaner_tpu.ops.stats import fft_diagnostic, fill_moments
+    from iterative_cleaner_tpu.ops.stats import fft_diagnostic
 
-    centred, mean, std, ptp = fused_fit_moments(
-        Dblk, template, w0blk, pulse_region=pulse_region,
+    centred, d_mean, d_std, d_ptp = fused_fit_moments(
+        Dblk, template, w0blk, validblk, pulse_region=pulse_region,
         interpret=interpret)
-    d_mean, d_std, d_ptp = fill_moments(mean, std, ptp, validblk)
     return d_std, d_mean, d_ptp, fft_diagnostic(centred)
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0, 1))
 def _finish(d_std, d_mean, d_ptp, d_fft, valid, w0, chanthresh, subintthresh):
     """Robust scalers + combine on the assembled (nsub, nchan) maps, then the
-    weight update (zap where test >= 1; NaN never flags, §8.L3)."""
+    weight update (zap where test >= 1; NaN never flags, §8.L3).
+
+    ``d_std``/``d_mean`` are donated (ROUTE_DONATIONS ledger): the maps are
+    freshly concatenated per step and dead after this call, and both alias
+    the equally-shaped f32 outputs (test, new_w) — two fewer (nsub, nchan)
+    allocations per iteration.  ``w0``/``valid`` are NOT donated: the
+    backend reuses them every step."""
     test = scale_and_combine(
         d_std, d_mean, d_ptp, d_fft, valid, chanthresh, subintthresh)
     return test, jnp.where(test >= 1.0, 0.0, w0)
@@ -139,10 +158,15 @@ class ChunkedJaxCleaner:
         cfg: CleanConfig,
         block: int,
         keep_residual: bool = False,
+        ingest_depth: int | None = None,
     ) -> None:
         from iterative_cleaner_tpu.backends.jax_backend import _x64_dtype
 
         self.cfg = cfg
+        # Staging depth of the upload pipeline (None → ICT_INGEST_DEPTH,
+        # default 2: next block uploads while the current one computes;
+        # 1 = the serial pre-pipeline path, kept for A/B parity).
+        self._ingest_depth = ingest_depth
         self.block = int(block)
         if self.block < 1:
             raise ValueError(f"block must be >= 1, got {block}")
@@ -163,53 +187,62 @@ class ChunkedJaxCleaner:
         self._use_pallas = False
         if cfg.pallas:
             from iterative_cleaner_tpu.ops.pallas_kernels import (
-                pallas_route_ok,
+                pallas_route_status,
             )
 
-            self._use_pallas = pallas_route_ok(self._D.shape[-1])
+            self._use_pallas, route_why = pallas_route_status(
+                self._D.shape[-1])
             if not self._use_pallas:
                 import warnings
 
                 warnings.warn(
-                    "pallas=True but the Pallas route is not viable here "
-                    "(non-TPU platform or nbin too large for VMEM); the "
-                    "chunked backend uses the XLA route", stacklevel=2)
+                    f"pallas=True but the Pallas route is not viable here "
+                    f"({route_why}); the chunked backend uses the XLA "
+                    f"route", stacklevel=2)
 
     def _blocks(self):
         nsub = self._D.shape[0]
         for lo in range(0, nsub, self.block):
             yield lo, min(lo + self.block, nsub)
 
+    def _load(self, lo: int, hi: int):
+        """One block, host slab → device dispatch.  Runs on the ingest
+        stager's background thread (ingest/pipeline.py) so the host-side
+        slice/copy/transfer of block k+1 hides under block k's compute."""
+        return jnp.asarray(self._D[lo:hi], self._dtype)
+
     @staticmethod
     def _sync(x) -> None:
         """Force one block's computation to completion via a tiny fetch.
 
         JAX dispatch is asynchronous: without a per-block sync the Python
-        loop would enqueue every block's device_put up front and the device
+        loop would enqueue every block's compute up front and the device
         would hold most of the cube at once — exactly the residency this
-        backend exists to bound.  Syncing on block k−1 before enqueuing
-        block k+1 keeps at most two blocks live (the budget in
-        autoshard.chunk_block_subints assumes this) while still overlapping
-        one upload with the previous block's compute.  (A scalar fetch, not
-        ``block_until_ready`` — the latter is unreliable on the axon-tunnel
-        platform the bench runs on.)
+        backend exists to bound.  ``stream_map`` calls this on block k−1's
+        output before returning the stager its upload credit, which keeps
+        at most two blocks live (the budget autoshard.chunk_block_subints
+        assumes) while block k+1's upload hides under block k's compute.
+        (A scalar fetch, not ``block_until_ready`` — the latter is
+        unreliable on the axon-tunnel platform the bench runs on.)
         """
         np.asarray(x[(0,) * x.ndim])
 
     def _template(self, w_prev) -> jnp.ndarray:
-        """Pass 1: template accumulation (device-resident accumulator)."""
+        """Pass 1: template accumulation (device-resident accumulator),
+        streamed through the double-buffered upload pipeline — block k+1
+        uploads while block k's partial accumulates.  The accumulation
+        order is the sequential block order either way, so the values are
+        identical to the serial path."""
         self.template_passes += 1
-        template = jnp.zeros(self._D.shape[-1], self._dtype)
-        prev = None
-        for lo, hi in self._blocks():
-            Dblk = jnp.asarray(self._D[lo:hi], self._dtype)
-            before = template
-            template = template + _partial_template(Dblk, w_prev[lo:hi])
-            if prev is not None:
-                self._sync(prev)
-            prev = before
-        self._sync(template)
-        return template
+        acc = [jnp.zeros(self._D.shape[-1], self._dtype)]
+
+        def accumulate(lo, hi, Dblk):
+            acc[0] = acc[0] + _partial_template(Dblk, w_prev[lo:hi])
+            return acc[0]
+
+        stream_map(self._blocks(), self._load, accumulate, self._sync,
+                   depth=self._ingest_depth)
+        return acc[0]
 
     def _template_for(self, w_host: np.ndarray) -> jnp.ndarray:
         """Template for these weights, incrementally when possible.
@@ -248,6 +281,11 @@ class ChunkedJaxCleaner:
                         self._tmpl,
                         jnp.asarray(dvals, self._dtype),
                         jnp.asarray(profs, self._dtype))
+                    # The call above DONATED the carried template; clear
+                    # the carry at once so no path (including an exception
+                    # in the dense fallback below) can hand the dead
+                    # buffer to a later call.
+                    self._tmpl = None
                     if bool(np.isfinite(np.asarray(cand)).all()):
                         tmpl = cand
         if tmpl is None:
@@ -272,31 +310,29 @@ class ChunkedJaxCleaner:
         template = self._template_for(w_host)
 
         # Pass 2: per-block fit + diagnostics; maps accumulate on device.
+        # Streamed through the upload pipeline: block k+1's host→device
+        # transfer hides under block k's kernels (ingest/pipeline.py).
         if self._use_pallas:
             from iterative_cleaner_tpu.ops.pallas_kernels import use_interpret
 
             interp = use_interpret()
-        maps: list[tuple] = []
-        prev = None
-        for lo, hi in self._blocks():
-            Dblk = jnp.asarray(self._D[lo:hi], self._dtype)
+
+        def block_stats(lo, hi, Dblk):
             if self._use_pallas:
-                out = _block_stats_pallas(
+                return _block_stats_pallas(
                     Dblk, template, self._w0[lo:hi], self._valid[lo:hi],
                     pulse_region=tuple(self.cfg.pulse_region),
                     interpret=interp,
                 )
-            else:
-                out = _block_stats(
-                    Dblk, template, self._w0[lo:hi], self._valid[lo:hi],
-                    pulse_region=tuple(self.cfg.pulse_region),
-                    want_resid=False,
-                )
-            if prev is not None:
-                self._sync(prev[0])
-            prev = out
-            maps.append(out[:4])
-        self._sync(maps[-1][0])
+            return _block_stats(
+                Dblk, template, self._w0[lo:hi], self._valid[lo:hi],
+                pulse_region=tuple(self.cfg.pulse_region),
+                want_resid=False,
+            )[:4]
+
+        maps = stream_map(self._blocks(), self._load, block_stats,
+                          lambda out: self._sync(out[0]),
+                          depth=self._ingest_depth)
 
         d_std, d_mean, d_ptp, d_fft = (
             jnp.concatenate([m[k] for m in maps], axis=0) for k in range(4))
@@ -327,13 +363,19 @@ class ChunkedJaxCleaner:
                     jnp.asarray(self._resid_w_prev, self._dtype))
             res_dtype = np.float64 if self.cfg.x64 else np.float32  # ict: f64-ok(explicit --x64 opt-in)
             self._residual = np.empty(self._D.shape, res_dtype)
-            for lo, hi in self._blocks():
-                Dblk = jnp.asarray(self._D[lo:hi], self._dtype)
+
+            def fetch_block(lo, hi, Dblk):
                 out = _block_stats(
                     Dblk, template, self._w0[lo:hi], self._valid[lo:hi],
                     pulse_region=tuple(self.cfg.pulse_region),
                     want_resid=True,
                 )
-                # Fetching the cube-sized block synchronises + frees it.
+                # Fetching the cube-sized block synchronises + frees it
+                # (so the per-output sync below is a no-op by design — the
+                # pipeline still prefetches block k+1 while this download
+                # runs).
                 self._residual[lo:hi] = np.asarray(out[4], res_dtype)
+
+            stream_map(self._blocks(), self._load, fetch_block,
+                       lambda _out: None, depth=self._ingest_depth)
         return self._residual

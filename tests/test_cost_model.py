@@ -163,9 +163,11 @@ class TestSparseBranchRuntimeSelection:
         new_w[0, 0] = 0.0
         new_w[3, 7] = 0.0
         new_w = jnp.asarray(new_w)
-        got = np.asarray(jb.advance_template(D, t0, w_prev, new_w))
         # The sparse-branch spec: T_prev plus the flipped profiles' delta.
+        # Computed BEFORE the call: advance_template donates T_prev (the
+        # ingest-tier ROUTE_DONATIONS ledger), so t0 is dead afterwards.
         expect = np.asarray(t0) - np.asarray(D[0, 0] + D[3, 7])
+        got = np.asarray(jb.advance_template(D, t0, w_prev, new_w))
         np.testing.assert_allclose(got, expect, rtol=1e-6, atol=1e-6)
         dense = np.asarray(jb.dense_template(D, new_w))
         assert not np.allclose(got, dense), (

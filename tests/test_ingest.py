@@ -1,0 +1,383 @@
+"""Ingest tier (iterative_cleaner_tpu/ingest/): the double-buffered
+host→device staging pipeline, the wire codec, and the donation ledger the
+tentpole registered — parity, protocol mechanics, and the perf-gate
+contract around them."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube
+from iterative_cleaner_tpu.ingest import codec, pipeline
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.ops.preprocess import preprocess
+from iterative_cleaner_tpu.parallel.chunked import ChunkedJaxCleaner
+
+
+def _cube(seed=80, nsub=8, nchan=16, nbin=64):
+    return preprocess(make_archive(nsub=nsub, nchan=nchan, nbin=nbin,
+                                   seed=seed))
+
+
+# ---------------------------------------------------------------- pipeline
+
+
+class TestPipelineParity:
+    """The pipeline moves bytes earlier; it must never change them."""
+
+    @pytest.mark.parametrize("block", [1, 3, 8])
+    def test_pipelined_step_equals_serial(self, block):
+        D, w0 = _cube()
+        cfg = CleanConfig(backend="jax")
+        t_p, w_p = ChunkedJaxCleaner(D, w0, cfg, block=block).step(w0)
+        t_s, w_s = ChunkedJaxCleaner(D, w0, cfg, block=block,
+                                     ingest_depth=1).step(w0)
+        np.testing.assert_array_equal(w_p, w_s)
+        # Scores too: identical kernels in identical order — bit-exact,
+        # not merely allclose (the serial/pipelined split happens strictly
+        # host-side).
+        np.testing.assert_array_equal(
+            np.asarray(t_p)[np.isfinite(t_p)],
+            np.asarray(t_s)[np.isfinite(t_s)])
+
+    def test_full_loop_pipelined_vs_serial_vs_oracle(self, monkeypatch):
+        D, w0 = _cube(seed=81)
+        res_p = clean_cube(
+            D, w0, CleanConfig(backend="jax", max_iter=4, chunk_block=3))
+        monkeypatch.setenv("ICT_INGEST_DEPTH", "1")
+        res_s = clean_cube(
+            D, w0, CleanConfig(backend="jax", max_iter=4, chunk_block=3))
+        monkeypatch.delenv("ICT_INGEST_DEPTH")
+        res_np = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=4))
+        np.testing.assert_array_equal(res_p.weights, res_s.weights)
+        np.testing.assert_array_equal(res_p.weights, res_np.weights)
+        assert res_p.loops == res_s.loops == res_np.loops
+
+    def test_residual_pipelined_equals_serial(self):
+        D, w0 = _cube(seed=82)
+        cfg = CleanConfig(backend="jax")
+        a = ChunkedJaxCleaner(D, w0, cfg, block=3, keep_residual=True)
+        a.step(w0)
+        b = ChunkedJaxCleaner(D, w0, cfg, block=3, keep_residual=True,
+                              ingest_depth=1)
+        b.step(w0)
+        np.testing.assert_array_equal(a.residual(), b.residual())
+
+
+class TestPipelineMechanics:
+    def test_order_and_values(self):
+        ranges = [(i, i + 2) for i in range(0, 10, 2)]
+        seen = []
+        outs = pipeline.stream_map(
+            ranges,
+            load=lambda lo, hi: np.arange(lo, hi),
+            compute=lambda lo, hi, blk: (lo, hi, blk.sum()),
+            sync=lambda out: seen.append(out[0]),
+        )
+        assert [o[:2] for o in outs] == ranges
+        assert [o[2] for o in outs] == [lo + lo + 1 for lo, _ in ranges]
+        assert seen == [lo for lo, _ in ranges]  # every output synced once
+
+    def test_load_exception_propagates(self):
+        def load(lo, hi):
+            if lo >= 4:
+                raise RuntimeError("boom in stager thread")
+            return np.zeros(2)
+
+        with pytest.raises(RuntimeError, match="boom in stager"):
+            pipeline.stream_map(
+                [(i, i + 2) for i in range(0, 10, 2)], load,
+                compute=lambda lo, hi, blk: blk, sync=lambda out: None)
+
+    def test_compute_exception_shuts_stager_down(self):
+        def compute(lo, hi, blk):
+            if lo >= 4:
+                raise ValueError("consumer died")
+            return blk
+
+        with pytest.raises(ValueError, match="consumer died"):
+            pipeline.stream_map(
+                [(i, i + 2) for i in range(0, 12, 2)],
+                load=lambda lo, hi: np.zeros(2),
+                compute=compute, sync=lambda out: None)
+
+    def test_serial_depth_counts_all_stall(self):
+        pipeline.reset_stats()
+        pipeline.stream_map(
+            [(0, 2), (2, 4)], load=lambda lo, hi: np.zeros((hi - lo, 8)),
+            compute=lambda lo, hi, blk: blk, sync=lambda out: None, depth=1)
+        s = pipeline.stats_snapshot()
+        assert s["serial_blocks"] == 2
+        assert s["overlap_efficiency"] == 0.0  # in-line loads hide nothing
+
+    def test_stream_depth_env(self, monkeypatch):
+        monkeypatch.setenv("ICT_INGEST_DEPTH", "1")
+        assert pipeline.stream_depth() == 1
+        monkeypatch.setenv("ICT_INGEST_DEPTH", "junk")
+        assert pipeline.stream_depth() == pipeline.DEFAULT_DEPTH
+        monkeypatch.delenv("ICT_INGEST_DEPTH")
+        assert pipeline.stream_depth() == pipeline.DEFAULT_DEPTH
+
+    def test_overlap_high_when_uploads_hide_under_compute(self):
+        import time
+
+        pipeline.reset_stats()
+
+        def compute(lo, hi, blk):
+            return blk
+
+        def slow_sync(out):
+            time.sleep(0.02)  # "device compute" dwarfing the 'upload'
+
+        pipeline.stream_map(
+            [(i, i + 1) for i in range(6)],
+            load=lambda lo, hi: np.zeros(1024),
+            compute=compute, sync=slow_sync, depth=2)
+        s = pipeline.stats_snapshot()
+        assert s["overlap_efficiency"] >= 0.5  # the acceptance floor
+
+
+# ------------------------------------------------------------------ codec
+
+
+class TestWireCodec:
+    def test_roundtrip_bit_exact_with_specials(self):
+        rng = np.random.default_rng(7)
+        data = rng.normal(size=(3, 2, 8, 32)).astype(np.float32)
+        data[0, 0, 0, 0] = np.nan
+        data[1, 1, 2, 3] = np.inf
+        data[2, 0, 1, 1] = -np.inf
+        data[0, 1, 4, 5] = -0.0
+        w = rng.random((3, 8)).astype(np.float32)
+        out = codec.decode_payload(
+            codec.encode_arrays({"data": data, "weights": w}))
+        # Byte-level identity, not just value equality: NaN payloads and
+        # signed zeros must survive the shuffle/deflate round trip.
+        assert out["data"].tobytes() == data.tobytes()
+        assert out["weights"].tobytes() == w.tobytes()
+
+    def test_legacy_npz_still_decodes(self):
+        from iterative_cleaner_tpu.online.blocks import (
+            decode_block,
+            encode_block,
+        )
+
+        data = np.ones((2, 1, 4, 16), np.float32)
+        w = np.ones((2, 4), np.float32)
+        d2, w2 = decode_block(encode_block(data, w, codec="npz"))
+        np.testing.assert_array_equal(d2, data)
+        np.testing.assert_array_equal(w2, w)
+
+    def test_env_codec_override(self, monkeypatch):
+        monkeypatch.setenv("ICT_WIRE_CODEC", "npz")
+        assert codec.wire_codec_name() == "npz"
+        monkeypatch.setenv("ICT_WIRE_CODEC", "shuffle-zlib")
+        assert codec.wire_codec_name() == "shuffle-zlib"
+        monkeypatch.setenv("ICT_WIRE_CODEC", "no-such-codec")
+        assert codec.wire_codec_name() in ("shuffle-zlib", "shuffle-zstd")
+
+    def test_overdeclared_header_rejected_before_decompression(self):
+        """A header declaring more raw bytes than the cap must be rejected
+        from the parsed header alone — no stream is ever inflated."""
+        wire = codec.encode_arrays({"a": np.zeros(8, np.float32)})
+        with pytest.raises(ValueError, match="before decompression"):
+            codec.decode_payload(wire, max_raw_bytes=8)  # declares 32
+
+    def test_inflating_stream_rejected_at_declared_size(self):
+        """A stream that inflates past the size its header declares is the
+        classic decompression bomb; the decoder must stop at the declared
+        size + 1, not inflate-then-check."""
+        import struct as _struct
+        import zlib as _zlib
+
+        bomb = _zlib.compress(b"\x00" * (1 << 20))  # 1 MB from ~1 KB
+        head = (b'{"codec":"shuffle-zlib","arrays":[{"name":"a",'
+                b'"shape":[1],"dtype":"float32","nbytes":%d}]}'
+                % len(bomb))  # declares 4 raw bytes
+        wire = b"".join([codec.MAGIC, _struct.pack("<I", len(head)),
+                         head, bomb])
+        with pytest.raises(ValueError, match="inflates past"):
+            codec.decode_payload(wire)
+
+    def test_malformed_payloads_raise_valueerror(self):
+        with pytest.raises(ValueError):
+            codec.decode_payload(b"total garbage")
+        with pytest.raises(ValueError):
+            codec.decode_payload(codec.MAGIC + b"\xff\xff\xff\xff")
+        good = codec.encode_arrays({"a": np.zeros(4, np.float32)})
+        with pytest.raises(ValueError):
+            codec.decode_payload(good[:-3])  # truncated stream
+
+    def test_compresses_real_archive_blocks(self):
+        """Structured archive data must actually shrink (the reason the
+        codec exists); pure-noise cubes are allowed to stay ~1.0."""
+        ar = make_archive(nsub=8, nchan=32, nbin=128, seed=42)
+        wire = codec.encode_arrays(
+            {"data": ar.data, "weights": ar.weights})
+        assert len(wire) < 0.95 * (ar.data.nbytes + ar.weights.nbytes)
+
+    def test_spooled_legacy_session_replays(self, tmp_path):
+        """A spool written by an OLD daemon (NPZ blocks) must materialize
+        through today's decode path unchanged."""
+        from iterative_cleaner_tpu.online.blocks import decode_block
+        import io
+
+        data = np.arange(2 * 1 * 4 * 16, dtype=np.float32).reshape(2, 1, 4, 16)
+        w = np.ones((2, 4), np.float32)
+        buf = io.BytesIO()
+        np.savez_compressed(buf, data=data, weights=w)  # the old writer
+        d2, w2 = decode_block(buf.getvalue())
+        np.testing.assert_array_equal(d2, data)
+
+
+# -------------------------------------------------- donations & contracts
+
+
+class TestDonationLedger:
+    def test_route_contracts_green(self):
+        from iterative_cleaner_tpu.analysis.contracts import (
+            check_routes,
+            pin_cpu_for_contracts,
+        )
+
+        pin_cpu_for_contracts()
+        assert check_routes() == []
+
+    def test_registered_donations_nonzero(self):
+        """The ingest PR's intent: stepwise and chunked carry REAL
+        donations now; a ledger regressed to all-zero is the exact silent
+        perf loss ICT009 exists to catch."""
+        from iterative_cleaner_tpu.analysis.contracts import ROUTE_DONATIONS
+
+        assert ROUTE_DONATIONS["stepwise"] == 1
+        assert ROUTE_DONATIONS["chunked"] == 3
+        assert ROUTE_DONATIONS["fused"] == 0   # caller-owned inputs reused
+        assert ROUTE_DONATIONS["sharded"] == 0
+
+    def test_advance_template_lowering_carries_alias(self):
+        import jax
+
+        from iterative_cleaner_tpu.backends.jax_backend import (
+            advance_template,
+        )
+
+        D = jax.ShapeDtypeStruct((4, 8, 64), np.float32)
+        t = jax.ShapeDtypeStruct((64,), np.float32)
+        w = jax.ShapeDtypeStruct((4, 8), np.float32)
+        text = advance_template.lower(D, t, w, w).as_text()
+        assert ("tf.aliasing_output" in text) or ("jax.buffer_donor" in text)
+
+    def test_donated_template_not_reused_by_stepwise_backend(self):
+        """Multi-iteration stepwise run on the incremental default: if any
+        donated buffer were re-read, jax raises on the dead buffer — three
+        iterations prove the carry discipline."""
+        from iterative_cleaner_tpu.backends.jax_backend import JaxCleaner
+
+        D, w0 = _cube(seed=83)
+        backend = JaxCleaner(D, w0, CleanConfig(backend="jax"))
+        w = w0
+        for _ in range(3):
+            _t, w = backend.step(w)
+        res_np = clean_cube(D, w0, CleanConfig(backend="numpy", max_iter=3))
+        np.testing.assert_array_equal(w, res_np.weights)
+
+
+# -------------------------------------------------------- payload contract
+
+
+class TestPerfGateIngestContract:
+    def test_gate_requires_ingest_block(self):
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "perf_gate", os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "tools", "perf_gate.py"))
+        pg = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pg)
+
+        base = {"donation_ledger": {"stepwise": 1, "fused": 0,
+                                    "chunked": 3, "sharded": 0},
+                "ingest": {"overlap_efficiency": 0.9}}
+        payload = {k: {} for k in pg.REQUIRED_KEYS}
+        payload.update(metric="m", value=1, unit="x", vs_baseline=1)
+        payload["memory"] = {"host_rss_bytes": 1}
+        payload["ingest"] = {"overlap_efficiency": 0.8,
+                             "codec": {"roundtrip_exact": True}}
+        payload["donation_ledger"] = dict(base["donation_ledger"])
+        assert pg.compare(payload, base, 3.0, 1.15) == []
+
+        # Missing ingest block → regression.
+        p2 = dict(payload)
+        del p2["ingest"]
+        assert any("ingest" in m for m in pg.compare(p2, base, 3.0, 1.15))
+        # Overlap collapse below the floor → regression.
+        p3 = dict(payload)
+        p3["ingest"] = {"overlap_efficiency": 0.1,
+                        "codec": {"roundtrip_exact": True}}
+        assert any("overlap" in m for m in pg.compare(p3, base, 3.0, 1.15))
+        # Ledger drift → regression, zero tolerance.
+        p4 = dict(payload)
+        p4["donation_ledger"] = {"stepwise": 0, "fused": 0,
+                                 "chunked": 3, "sharded": 0}
+        assert any("donation_ledger" in m
+                   for m in pg.compare(p4, base, 3.0, 1.15))
+        # Codec corruption → regression.
+        p5 = dict(payload)
+        p5["ingest"] = {"overlap_efficiency": 0.8,
+                        "codec": {"roundtrip_exact": False}}
+        assert any("roundtrip" in m for m in pg.compare(p5, base, 3.0, 1.15))
+
+
+# -------------------------------------------------- pallas route reasons
+
+
+class TestPallasRouteStatus:
+    def test_cpu_is_viable_with_reason(self):
+        from iterative_cleaner_tpu.ops import pallas_kernels as pk
+
+        ok, why = pk.pallas_route_status(256)
+        assert ok and "interpret" in why
+
+    def test_gpu_rejected_with_reason(self, monkeypatch):
+        from iterative_cleaner_tpu.ops import pallas_kernels as pk
+
+        monkeypatch.setattr(pk, "_platform", lambda: "gpu")
+        ok, why = pk.pallas_route_status(256)
+        assert not ok and "gpu" in why and "interpret" in why
+
+    def test_huge_nbin_rejected_with_vmem_reason(self, monkeypatch):
+        from iterative_cleaner_tpu.ops import pallas_kernels as pk
+
+        monkeypatch.setattr(pk, "_platform", lambda: "tpu")
+        ok, why = pk.pallas_route_status(65536)
+        assert not ok and "VMEM" in why and "65536" in why
+        ok_small, why_small = pk.pallas_route_status(1024)
+        assert ok_small  # the bench config is viable on TPU
+        assert pk.pallas_route_ok(1024)
+
+
+class TestOnlineSessionThroughPipeline:
+    def test_session_ingest_serial_vs_pipelined_alerts_match(self,
+                                                             monkeypatch):
+        from iterative_cleaner_tpu.online.session import OnlineSession
+        from iterative_cleaner_tpu.online.state import SessionMeta
+
+        ar = make_archive(nsub=6, nchan=16, nbin=64, seed=90)
+        meta = SessionMeta.from_archive(ar)
+
+        def run():
+            s = OnlineSession(meta, CleanConfig(backend="jax"))
+            a1 = s.ingest(ar.data[:3], ar.weights[:3])
+            a2 = s.ingest(ar.data[3:], ar.weights[3:])
+            return (a1.n_new_zaps, a2.n_new_zaps,
+                    s.state.prov_w.copy())
+
+        z1 = run()
+        monkeypatch.setenv("ICT_INGEST_DEPTH", "1")
+        z2 = run()
+        assert z1[0] == z2[0] and z1[1] == z2[1]
+        np.testing.assert_array_equal(z1[2], z2[2])

@@ -2,7 +2,8 @@
 
 Draws random configurations with tests/test_fuzz_equivalence.py's generator
 and demands bit-identical final masks between the numpy oracle and every JAX
-execution mode — stepwise, fused, chunked (random block), the 8-device
+execution mode — stepwise, fused, chunked (random block, both the pipelined
+ingest default and the ICT_INGEST_DEPTH=1 serial path), the 8-device
 sharded path, and the streaming-ingest online route (random block splits,
 canonical finalize) — plus loop-count agreement.  Any failing seed is
 reproducible directly in the CI test by adding it to the parametrize range.
@@ -86,13 +87,32 @@ def main() -> int:
                          incremental_template=False, **kw)),
             # chunk_block routes through the canonical stepwise loop with
             # the streaming backend — no hand-rolled convergence here.
+            # The default exercises the double-buffered ingest pipeline;
+            # the _serial mode pins the pre-pipeline in-line path
+            # (ICT_INGEST_DEPTH=1) so the two can never drift apart.
             (f"chunked(b={block})",
+             CleanConfig(backend="jax", chunk_block=block, x64=x64, **kw)),
+            (f"chunked_serial(b={block})",
              CleanConfig(backend="jax", chunk_block=block, x64=x64, **kw)),
             (f"chunked_dense(b={block})",
              CleanConfig(backend="jax", chunk_block=block, x64=x64,
                          incremental_template=False, **kw)),
         ):
-            r = clean_cube(D, w0, cfg)
+            serial_ingest = name.startswith("chunked_serial")
+            if serial_ingest:
+                # Force serial for this mode only, restoring whatever the
+                # caller had exported (the plain chunked modes must keep
+                # running the ambient — normally pipelined — depth).
+                prior_depth = os.environ.get("ICT_INGEST_DEPTH")
+                os.environ["ICT_INGEST_DEPTH"] = "1"
+            try:
+                r = clean_cube(D, w0, cfg)
+            finally:
+                if serial_ingest:
+                    if prior_depth is None:
+                        os.environ.pop("ICT_INGEST_DEPTH", None)
+                    else:
+                        os.environ["ICT_INGEST_DEPTH"] = prior_depth
             modes[name] = (r.weights, r.loops, r.converged)
             mode_cfgs[name] = cfg
 
