@@ -68,6 +68,18 @@ HBM_PEAK_GBPS = {"TPU v5 lite": 819.0}
 PHASE_CUBE_PASSES = {"template": 1.0, "fit": 3.0, "moments": 2.0,
                      "fft": 2.0, "scalers": 0.0}
 
+# The same model with the Pallas stats megakernel on (the r06 TPU default):
+# fit + pulse-region scale + weight pre-scale + centre + filled moment maps
+# collapse into ONE kernel that reads D once and writes the centred cube
+# once; the FFT tail is unchanged (TPU FFT is an XLA primitive) and the
+# selection-median scalers still touch only the (nsub, nchan) maps.  The
+# template keeps its dense-build pass in the model — the incremental default
+# drops it from iteration 2 identically on both routes.  Both sums travel in
+# the payload's static_analysis block and tools/perf_gate.py ratchets them:
+# a kernel change that re-reads the cube must update the model loudly.
+PALLAS_PHASE_CUBE_PASSES = {"template": 1.0, "megakernel": 2.0,
+                            "fft": 2.0, "scalers": 0.0}
+
 _PAYLOAD: dict = {}   # filled incrementally; error paths dump what exists
 
 
@@ -447,16 +459,37 @@ def _bench_config(tag, nsub, nchan, nbin, *, full_numpy, dev):
 
 
 def _bench_phases(state, dev_kind) -> dict:
-    """Cumulative-ablation per-phase timings of one XLA step + HBM GB/s."""
+    """Cumulative-ablation per-phase timings of one XLA step + HBM GB/s.
+
+    Attribution contract (the r06 fix): every stage's program is a strict
+    SUPERSET of the previous stage's, and every timed closure ends in the
+    tiny-fetch sync (``_force``) so the async dispatch is forced complete
+    BEFORE ``_min_time`` reads the stop timer.  BENCH_r05 broke the first
+    half — its fft stage omitted the std/ptp/fill moment work, so the fft
+    delta went negative (clamped to ``fft: 0.0``) while ``scalers``
+    absorbed the real FFT time — exactly the misattribution the phase-share
+    ratchet (tools/perf_gate.py) now pins against.
+    """
     import jax
     import jax.numpy as jnp
 
     from iterative_cleaner_tpu.backends.jax_backend import clean_step
-    from iterative_cleaner_tpu.ops.stats import fft_diagnostic
+    from iterative_cleaner_tpu.ops.stats import fft_diagnostic, fill_moments
     from iterative_cleaner_tpu.ops.template import build_template, fit_and_subtract
 
     D, w0, Dd, w0d, validd, _ = state
     cube_bytes = D.nbytes
+
+    def _moment_maps(D, w, w0, valid):
+        t = build_template(D, w)
+        _amp, resid = fit_and_subtract(D, t, (0.0, 0.0, 1.0))
+        weighted = resid * w0[..., None]
+        mean = jnp.mean(weighted, axis=-1)
+        centred = weighted - mean[..., None]
+        std = jnp.sqrt(jnp.mean(centred * centred, axis=-1))
+        ptp = jnp.max(weighted, axis=-1) - jnp.min(weighted, axis=-1)
+        d_mean, d_std, d_ptp = fill_moments(mean, std, ptp, valid)
+        return centred, d_mean, d_std, d_ptp
 
     @jax.jit
     def p_template(D, w):
@@ -469,44 +502,39 @@ def _bench_phases(state, dev_kind) -> dict:
         return jnp.sum(resid)
 
     @jax.jit
-    def p_fft(D, w, w0):
-        t = build_template(D, w)
-        _amp, resid = fit_and_subtract(D, t, (0.0, 0.0, 1.0))
-        weighted = resid * w0[..., None]
-        centred = weighted - jnp.mean(weighted, axis=-1, keepdims=True)
-        return jnp.sum(fft_diagnostic(centred))
-
-    # diagnostics() computes the fft too, so the moments stage rebuilds just
-    # the moment part (same ops, same order).
-    @jax.jit
-    def p_moments_only(D, w, w0, valid):
-        from iterative_cleaner_tpu.ops.stats import fill_moments
-
-        t = build_template(D, w)
-        _amp, resid = fit_and_subtract(D, t, (0.0, 0.0, 1.0))
-        weighted = resid * w0[..., None]
-        mean = jnp.mean(weighted, axis=-1)
-        centred = weighted - mean[..., None]
-        std = jnp.sqrt(jnp.mean(centred * centred, axis=-1))
-        ptp = jnp.max(weighted, axis=-1) - jnp.min(weighted, axis=-1)
-        d_mean, d_std, d_ptp = fill_moments(mean, std, ptp, valid)
+    def p_moments(D, w, w0, valid):
+        _centred, d_mean, d_std, d_ptp = _moment_maps(D, w, w0, valid)
         return jnp.sum(d_std) + jnp.sum(d_mean) + jnp.sum(d_ptp)
 
+    @jax.jit
+    def p_fft(D, w, w0, valid):
+        # Superset of p_moments (NOT a sibling that drops the std/ptp work):
+        # the delta vs p_moments is the FFT diagnostic alone.
+        centred, d_mean, d_std, d_ptp = _moment_maps(D, w, w0, valid)
+        return (jnp.sum(d_std) + jnp.sum(d_mean) + jnp.sum(d_ptp)
+                + jnp.sum(fft_diagnostic(centred)))
+
     def run_full():
+        # The mask fetch is itself the completion sync for the full step.
         np.asarray(clean_step(Dd, w0d, validd, w0d, 5.0, 5.0,
                               pulse_region=(0.0, 0.0, 1.0))[1])
 
     stages = [
         ("template", lambda: _force(p_template(Dd, w0d))),
         ("fit", lambda: _force(p_fit(Dd, w0d))),
-        ("moments", lambda: _force(p_moments_only(Dd, w0d, w0d, validd))),
-        ("fft", lambda: _force(p_fft(Dd, w0d, w0d))),
+        ("moments", lambda: _force(p_moments(Dd, w0d, w0d, validd))),
+        ("fft", lambda: _force(p_fft(Dd, w0d, w0d, validd))),
         ("full_step", run_full),
     ]
     cum = {}
     for name, fn in stages:
         fn()  # compile
-        cum[name] = _min_time(fn)
+        # More repetitions than the headline timings: the deltas are
+        # DIFFERENCES of stage minima, so each stage's min must converge
+        # (a load spike inflating one stage's min skews two phases at
+        # once — the share ratchet reads these).  Minima are monotone in
+        # reps; 7 keeps the section under a second at the gate shape.
+        cum[name] = _min_time(fn, n=7)
     deltas = {
         "template": cum["template"],
         "fit": cum["fit"] - cum["template"],
@@ -515,6 +543,11 @@ def _bench_phases(state, dev_kind) -> dict:
         "scalers": cum["full_step"] - cum["fft"],
     }
     phase_s = {k: round(max(v, 0.0), 4) for k, v in deltas.items()}
+    step_s = max(cum["full_step"], 1e-9)
+    # Phase shares are intra-run ratios (machine speed cancels, like the
+    # speedup ratios): the scalers share is the figure the selection-median
+    # work targets and tools/perf_gate.py ratchets.
+    phase_share = {k: round(max(v, 0.0) / step_s, 4) for k, v in deltas.items()}
     phase_gbps = {}
     for k, passes in PHASE_CUBE_PASSES.items():
         if passes and deltas[k] > 1e-5:
@@ -523,6 +556,7 @@ def _bench_phases(state, dev_kind) -> dict:
     achieved = total_passes * cube_bytes / 1e9 / max(cum["full_step"], 1e-9)
     res = {
         "phase_s": phase_s,
+        "phase_share": phase_share,
         "phase_gbps_model": phase_gbps,
         "phase_cube_passes_model": PHASE_CUBE_PASSES,
         "unfused_step_s": round(cum["full_step"], 4),
@@ -533,7 +567,8 @@ def _bench_phases(state, dev_kind) -> dict:
         res["hbm_peak_gbps"] = peak
         res["hbm_efficiency"] = round(achieved / peak, 3)
     log(f"[phases] {phase_s} achieved ~{achieved:.0f} GB/s "
-        f"(model: {total_passes:.0f} cube passes/step)")
+        f"(model: {total_passes:.0f} cube passes/step; scalers share "
+        f"{phase_share['scalers']:.2f})")
     return res
 
 
@@ -554,13 +589,18 @@ def _bench_pallas(state) -> dict:
         # The structured reason (platform / nbin / tile constraints) from
         # the route check itself; a viable-but-interpreted platform (the
         # CPU harness) is its own reason — compiled-kernel timings there
-        # would be interpreter timings, not data.
+        # would be interpreter timings, not data.  The would-be-TPU status
+        # rides along so the viability claim at THIS bench shape stays
+        # visible without hardware: it answers "would the auto default
+        # take the megakernel on a real chip for this cube".
+        ok_tpu, why_tpu = pallas_route_status(nbin, platform="tpu")
         reason = route_why if not route_ok else (
             f"viable but interpret-mode here ({route_why}): compiled-kernel "
             f"timings are only meaningful on tpu")
         return {"skipped": reason,
                 "platform": jax.default_backend(),  # ict: backend-init-ok(after _init_device)
-                "nbin": nbin}
+                "nbin": nbin,
+                "would_be_tpu_status": {"viable": ok_tpu, "why": why_tpu}}
     kw = dict(max_iter=MAX_ITER, pulse_region=(0.0, 0.0, 1.0),
               use_pallas=True)
     t0 = time.time()
@@ -713,6 +753,39 @@ def _bench_static_analysis() -> dict:
         D, w, v, t, s, s, pulse_region=pr, use_pallas=False).compile()
     incr = cost_cubes(incr_c)
 
+    # The in-memory stats phase proper (weighted residuals -> scores): the
+    # executables the selection-median work changed.  stats_bytes_cubes is
+    # cube-relative (the diagnostics read the weighted cube); the scalers
+    # never touch the cube, so their figure is in MAP units — and the
+    # sort-launch count of the same lowering is recorded too (the r05
+    # profile was sort-LAUNCH dominated, not bytes dominated).  All three
+    # are deterministic XLA facts on a pinned jax version; perf_gate
+    # ratchets them.
+    from iterative_cleaner_tpu.ops.stats import (
+        comprehensive_stats,
+        scale_and_combine,
+    )
+
+    Wc = jax.ShapeDtypeStruct(shape, np.float32)
+    stats_full_c = jax.jit(
+        lambda weighted, valid: comprehensive_stats(
+            weighted, valid, 5.0, 5.0)).lower(Wc, v).compile()
+    nmap = jax.ShapeDtypeStruct((nsub, nchan), np.float32)
+    map_bytes = float(nsub * nchan * 4)
+    scalers_c = jax.jit(
+        lambda a, b, c, d, valid: scale_and_combine(
+            a, b, c, d, valid, 5.0, 5.0)).lower(
+            nmap, nmap, nmap, nmap, v).compile()
+
+    def sort_ops(compiled) -> int:
+        """Optimized-HLO sort launches (" sort(" heads every variadic sort
+        op); selection medians show up as this count dropping (top_k and
+        the median-of-4 network lower to other ops)."""
+        try:
+            return compiled.as_text().count(" sort(")
+        except Exception:  # noqa: BLE001 — count is best-effort detail
+            return -1
+
     # The streaming stats pass (chunked route, one block): the executable
     # the ingest pipeline feeds.  Measured in BLOCK-sized units — the
     # deterministic bytes-per-slab figure tools/perf_gate.py ratchets so a
@@ -746,6 +819,9 @@ def _bench_static_analysis() -> dict:
         obs_memory.note_executable(f"{bucket}:fused", fused)
     except Exception:  # noqa: BLE001 — the section's own keys still land
         pass
+    ca_sc = scalers_c.cost_analysis()
+    if isinstance(ca_sc, (list, tuple)):
+        ca_sc = ca_sc[0]
     res = {
         "backend": jax.default_backend(),  # ict: backend-init-ok(after _init_device)
         "shape": list(shape),
@@ -755,6 +831,16 @@ def _bench_static_analysis() -> dict:
         "fused_bytes_cubes": cost_cubes(fused),
         "chunked_stats_bytes_cubes": chunked_stats,
         "chunked_stats_block_subints": blk_sub,
+        # r06 selection-median / megakernel figures (all ratcheted):
+        "stats_bytes_cubes": cost_cubes(stats_full_c),
+        "scalers_bytes_maps": round(
+            float(ca_sc["bytes accessed"]) / map_bytes, 2),
+        "stats_sort_ops": sort_ops(stats_full_c),
+        "step_cube_passes_model_xla": round(
+            sum(PHASE_CUBE_PASSES.values()), 2),
+        "step_cube_passes_model_pallas": round(
+            sum(PALLAS_PHASE_CUBE_PASSES.values()), 2),
+        "pallas_phase_cube_passes_model": PALLAS_PHASE_CUBE_PASSES,
     }
     try:
         ma = fused.memory_analysis()
@@ -766,7 +852,11 @@ def _bench_static_analysis() -> dict:
         res["memory_analysis_error"] = str(exc)
     log(f"[static] XLA accounting ({res['backend']}): dense step {dense} "
         f"cubes vs incremental {incr} (saves {res['incremental_saves_cubes']}"
-        f"); fused working set {res.get('peak_cube_factor_static')} cubes "
+        f"); stats {res['stats_bytes_cubes']} cubes / scalers "
+        f"{res['scalers_bytes_maps']} maps / {res['stats_sort_ops']} sort "
+        f"launches; step model {res['step_cube_passes_model_xla']} cube "
+        f"passes (xla) vs {res['step_cube_passes_model_pallas']} (pallas); "
+        f"fused working set {res.get('peak_cube_factor_static')} cubes "
         f"(routing constant {PEAK_CUBE_FACTOR})")
     return res
 

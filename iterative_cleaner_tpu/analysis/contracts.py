@@ -174,6 +174,7 @@ def _route_lowerings():
     )
     from iterative_cleaner_tpu.parallel.chunked import (
         _block_stats,
+        _block_stats_pallas,
         _finish,
         _partial_template,
         _sparse_template_update,
@@ -203,9 +204,14 @@ def _route_lowerings():
 
     entries = [
         # The stepwise route: dense step, incremental step + the sparse
-        # template advance it carries between iterations.
+        # template advance it carries between iterations — each in both
+        # lowerings (XLA, and the Pallas stats megakernel that is the
+        # r06 TPU default; off-TPU the trace captures the interpret-mode
+        # pallas_call, whose inner jaxpr the same checks walk).
         ("stepwise", "clean_step", clean_step,
          (D, w, v, w, s, s), {"pulse_region": pr, "use_pallas": False}),
+        ("stepwise", "clean_step_pallas", clean_step,
+         (D, w, v, w, s, s), {"pulse_region": pr, "use_pallas": True}),
         ("stepwise", "step_from_template", step_from_template,
          (D, w, v, t, s, s), {"pulse_region": pr, "use_pallas": False}),
         ("stepwise", "advance_template", advance_template,
@@ -214,19 +220,31 @@ def _route_lowerings():
         ("fused", "fused_clean", fused_clean, (D, w, v, s, s),
          {"max_iter": TINY_MAX_ITER, "pulse_region": pr,
           "want_residual": False, "use_pallas": False, "incremental": True}),
-        # The chunked (>HBM streaming) route's four kernels.
+        ("fused", "fused_clean_pallas", fused_clean, (D, w, v, s, s),
+         {"max_iter": TINY_MAX_ITER, "pulse_region": pr,
+          "want_residual": False, "use_pallas": True, "incremental": True}),
+        # The chunked (>HBM streaming) route's five kernels.
         ("chunked", "partial_template", _partial_template, (D, w), {}),
         ("chunked", "block_stats", _block_stats, (D, t, w, v),
          {"pulse_region": pr, "want_resid": False}),
+        ("chunked", "block_stats_pallas", _block_stats_pallas, (D, t, w, v),
+         {"pulse_region": pr, "interpret": True}),
         ("chunked", "sparse_template_update", _sparse_template_update,
          (t, dvals, profs), {}),
         ("chunked", "finish", _finish,
          (nstat, nstat, nstat, nstat, v, w, s, s), {}),
         # The sharded batch route (vmapped fused loop; shardings are
         # call-time input properties, the traced computation is this).
+        # The pallas variant pins the vmapped megakernel lowering the
+        # non-mesh batch path may take; mesh-sharded dispatches keep it
+        # off by policy (see batched_fused_clean's docstring).
         ("sharded", "batched_fused_clean", batched_fused_clean,
          (Db, wb, vb, s, s),
          {"max_iter": TINY_MAX_ITER, "pulse_region": pr}),
+        ("sharded", "batched_fused_clean_pallas", batched_fused_clean,
+         (Db, wb, vb, s, s),
+         {"max_iter": TINY_MAX_ITER, "pulse_region": pr,
+          "use_pallas": True}),
     ]
     for route, label, fn, args, kwargs in entries:
         lowered = fn.lower(*args, **kwargs)

@@ -184,8 +184,10 @@ def precompile_for(shape, cfg, want_residual: bool = False) -> None:
     v = w != 0  # the real paths derive validity this way — warm that tiny
     #             executable too, not just the big one
     t = jnp.zeros((nbin,), dtype)
+    from iterative_cleaner_tpu.ops.pallas_kernels import resolve_use_pallas
+
     pr = tuple(cfg.pulse_region)
-    use_pallas = cfg.pallas and not want_residual
+    use_pallas = resolve_use_pallas(cfg, nbin, want_residual)
     incremental = cfg.incremental_template and not want_residual
     if cfg.fused:
         out = fused_clean(
@@ -382,8 +384,15 @@ class JaxCleaner:
     documented for scores only)."""
 
     def __init__(self, D: np.ndarray, w0: np.ndarray, cfg: CleanConfig) -> None:
+        from iterative_cleaner_tpu.ops.pallas_kernels import resolve_use_pallas
+
         self.cfg = cfg
         dtype = _x64_dtype(cfg)
+        # The megakernel static this backend dispatches with (cfg.pallas is
+        # tri-state; None = auto-on where it is a real optimisation).  An
+        # explicit True on a non-viable shape still warns-and-falls-back
+        # inside _step_from_template.
+        self._use_pallas = resolve_use_pallas(cfg, D.shape[-1])
         self._D = jax.device_put(jnp.asarray(D, dtype))
         self._w0 = jax.device_put(jnp.asarray(w0, dtype))
         self._valid = jax.device_put(jnp.asarray(w0 != 0))
@@ -402,7 +411,7 @@ class JaxCleaner:
                 float(self.cfg.chanthresh),
                 float(self.cfg.subintthresh),
                 pulse_region=tuple(self.cfg.pulse_region),
-                use_pallas=self.cfg.pallas,
+                use_pallas=self._use_pallas,
             )
         else:
             if self._tmpl is None:
@@ -422,7 +431,7 @@ class JaxCleaner:
                 float(self.cfg.chanthresh),
                 float(self.cfg.subintthresh),
                 pulse_region=tuple(self.cfg.pulse_region),
-                use_pallas=self.cfg.pallas,
+                use_pallas=self._use_pallas,
             )
         self._residual = resid  # stays on device unless fetched
         return np.asarray(test), np.asarray(new_w)
@@ -438,6 +447,8 @@ def run_fused(D, w0, cfg: CleanConfig, want_residual: bool = False):
     mode dumps the same mask-history audit trail as the stepwise loop.
     Accepts numpy or device-resident arrays (pass device arrays to keep the
     cube upload out of timing loops)."""
+    from iterative_cleaner_tpu.ops.pallas_kernels import resolve_use_pallas
+
     dtype = _x64_dtype(cfg)
     D = jnp.asarray(D, dtype)
     w0 = jnp.asarray(w0, dtype)
@@ -450,7 +461,7 @@ def run_fused(D, w0, cfg: CleanConfig, want_residual: bool = False):
         max_iter=int(cfg.max_iter),
         pulse_region=tuple(cfg.pulse_region),
         want_residual=want_residual,
-        use_pallas=cfg.pallas and not want_residual,
+        use_pallas=resolve_use_pallas(cfg, D.shape[-1], want_residual),
         # A residual must come from a dense template (bit-exact output;
         # the sparse path's ulp envelope is documented for scores only).
         incremental=cfg.incremental_template and not want_residual,

@@ -71,10 +71,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="jax: run the whole iteration loop as one device "
                         "dispatch (per-loop progress is derived afterwards "
                         "from the on-device mask history)")
-    p.add_argument("--pallas", action="store_true",
-                   help="jax: use the fused Pallas TPU kernel for the "
-                        "fit+moments hot path (one HBM pass over the cube; "
-                        "incompatible with --unload_res)")
+    p.add_argument("--pallas", action="store_const", const=True,
+                   default=None, dest="pallas",
+                   help="jax: force the fused Pallas stats megakernel (one "
+                        "HBM pass over the cube for fit+moments; "
+                        "incompatible with --unload_res).  Default is AUTO: "
+                        "on a TPU it engages whenever the shape is viable "
+                        "and the request allows it; --no_pallas forces the "
+                        "XLA route")
+    p.add_argument("--no_pallas", action="store_const", const=False,
+                   dest="pallas", help=argparse.SUPPRESS)
     p.add_argument("--x64", action="store_true",
                    help="jax: float64 intermediates (requires JAX_ENABLE_X64=1)")
     p.add_argument("--sharded_batch", action="store_true",

@@ -77,7 +77,13 @@ class CleanConfig:
     # --- TPU framework extensions ---
     backend: str = "numpy"         # {'numpy', 'jax'}
     fused: bool = False            # jax: run the whole loop as one lax.while_loop
-    pallas: bool = False           # jax: fused Pallas kernel for fit+moments
+    pallas: bool | None = None     # jax: fused Pallas stats megakernel.
+                                   # None (default) = AUTO: on whenever it is
+                                   # a real optimisation (TPU + viable shape +
+                                   # no residual/x64 request — see
+                                   # ops/pallas_kernels.resolve_use_pallas);
+                                   # True forces it (errors on impossible
+                                   # combos below), False forces XLA.
     x64: bool = False              # jax: use float64 intermediates for bit parity
     sharded_batch: bool = False    # clean same-shape archives together on the mesh
     auto_shard: bool = True        # shard one cube over devices when it exceeds HBM

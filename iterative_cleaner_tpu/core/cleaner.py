@@ -326,11 +326,14 @@ def clean_cube(
             f"{' (' + '; '.join(notes) + ')' if notes else ''}",
             file=sys.stderr)
 
-    if want_residual and cfg.pallas:
+    if want_residual and cfg.pallas is not False:
         # The Pallas kernel does not materialise the residual; fall back to
-        # the XLA route for this request (resolved BEFORE the compile-cache
-        # key below so the key matches the executable actually compiled;
-        # run_fused applies the same fallback internally).
+        # the XLA route for this request — for the tri-state auto default
+        # (None) as well as an explicit True, because JaxCleaner resolves
+        # auto WITHOUT the want_residual context (resolved BEFORE the
+        # compile-cache key below so the key matches the executable
+        # actually compiled; run_fused applies the same fallback
+        # internally).
         cfg = cfg.replace(pallas=False)
     if want_residual and cfg.incremental_template and chunk_block is None:
         # Residual output must be bit-exact (dense templates): the sparse
@@ -350,14 +353,16 @@ def clean_cube(
             # Chunked executables are keyed by the block slab shape, not the
             # cube: distinct-nsub cubes sharing one block size reuse one
             # executable set and must not count as distinct shapes.
-            # Mirror ChunkedJaxCleaner's runtime demotion so the pallas axis
-            # reflects the executable actually compiled.
-            use_pallas = cfg.pallas
-            if use_pallas:
-                from iterative_cleaner_tpu.ops.pallas_kernels import (
-                    pallas_route_ok,
-                )
+            # Mirror ChunkedJaxCleaner's runtime resolution (tri-state
+            # cfg.pallas + viability demotion) so the pallas axis reflects
+            # the executable actually compiled.
+            from iterative_cleaner_tpu.ops.pallas_kernels import (
+                pallas_route_ok,
+                resolve_use_pallas,
+            )
 
+            use_pallas = resolve_use_pallas(cfg, nbin)
+            if use_pallas:
                 use_pallas = pallas_route_ok(nbin)
             # The step loop always compiles the want_resid=False variant;
             # a residual request additionally compiles the want_resid=True

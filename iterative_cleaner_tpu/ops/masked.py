@@ -11,25 +11,127 @@ behaviors the oracle inherits (SURVEY.md §7 "hard parts" #1):
 All functions are dtype-polymorphic (python-scalar literals only) so the same
 code runs f32 on TPU and f64 under ``jax_enable_x64`` for bit-parity
 debugging.
+
+Selection lowering (the r06 scalers optimisation): a median never needs the
+whole sorted axis — only the two middle *elements* — so the hot-path medians
+can run as a k-th order-statistic selection (``lax.top_k`` over total-order
+integer keys) instead of a full ``jnp.sort``: O(n log k) work and a
+k-element output instead of O(n log n) and a full sorted copy.  Selection
+picks the *same elements* the sort would put at the selected positions
+(see :func:`sort_prefix` for the exact tie/NaN/−0.0 argument), so the two
+lowerings are bit-identical — masks AND scores — and the choice is pure
+lowering policy:
+
+- ``ICT_MEDIAN_SELECT=sort``  — the full-sort reference lowering;
+- ``ICT_MEDIAN_SELECT=topk``  — the selection lowering everywhere;
+- ``ICT_MEDIAN_SELECT=auto``  (default) — selection on TPU (where XLA's
+  TopK is a tuned partial reduction and full sorts are the measured
+  bottleneck, BENCH_r05), full sort elsewhere (XLA *CPU* lowers top_k
+  slower than its single-operand sort — measured 1.1–1.4× — so the CPU
+  harness keeps the fast path while pinning the selection lowering
+  bit-identical via tests/test_selection_medians.py).
+
+Read once at import, like ``ICT_TEMPLATE_LOWERING`` (ops/template.py): the
+mode participates in traced computations, so flipping it mid-process would
+silently miss already-compiled executables.
 """
 
 from __future__ import annotations
 
+import os
+
+import jax
 import jax.numpy as jnp
 
+_SELECT = os.environ.get("ICT_MEDIAN_SELECT", "auto")
+if _SELECT not in ("auto", "sort", "topk"):
+    raise ValueError(
+        f"ICT_MEDIAN_SELECT={_SELECT!r}: expected one of auto|sort|topk")
 
-def masked_median(x: jnp.ndarray, valid: jnp.ndarray, axis: int):
+
+def median_select_mode() -> str:
+    """The resolved selection lowering: ``"sort"`` or ``"topk"``.
+
+    ``auto`` resolves per platform at trace time (each backend traces and
+    compiles its own executable, so the resolution is always consistent
+    with the device the computation runs on).
+    """
+    if _SELECT != "auto":
+        return _SELECT
+    dev = jax.config.jax_default_device
+    if dev is None:
+        # Trace/dispatch-time read: compute follows on this very backend.
+        platform = jax.default_backend()  # ict: backend-init-ok(dispatch-time; compute follows on this thread)
+    else:
+        platform = dev if isinstance(dev, str) else dev.platform
+    return "topk" if platform == "tpu" else "sort"
+
+
+def _totalorder_keys(x: jnp.ndarray) -> jnp.ndarray:
+    """Monotone integer keys reproducing ``jnp.sort``'s float order.
+
+    jax's float sort comparator (lax._sort_lt_comparator) canonicalizes
+    before comparing — every ±0.0 to +0.0 and every NaN to the canonical
+    quiet NaN — then compares in the IEEE total order, so −0.0 ties +0.0,
+    all NaNs tie each other, and NaNs sort after +inf.  Reproducing that
+    exactly: canonicalize the same way, then the standard sign-magnitude →
+    two's-complement key flip.  Equal keys ⇔ the comparator calls the
+    elements equal, which is what makes index-stable selection on these
+    keys reproduce the stable sort (see :func:`sort_prefix`).
+    """
+    if x.dtype == jnp.float64:  # ict: f64-ok(x64 opt-in path; integer sort keys only, no f64 math)
+        ik, mask = jnp.int64, jnp.int64(0x7FFFFFFFFFFFFFFF)
+    else:
+        ik, mask = jnp.int32, jnp.int32(0x7FFFFFFF)
+    xc = jnp.where(x == 0, jnp.zeros((), x.dtype), x)
+    xc = jnp.where(jnp.isnan(x), jnp.full((), jnp.nan, x.dtype), xc)
+    i = jax.lax.bitcast_convert_type(xc, ik)
+    return jnp.where(i < 0, i ^ mask, i)
+
+
+def sort_prefix(x: jnp.ndarray, k: int, mode: str | None = None) -> jnp.ndarray:
+    """``jnp.sort(x, axis=-1)[..., :k]`` — bit-identically, by selection.
+
+    With ``mode="sort"`` this IS that expression (the reference lowering).
+    With ``mode="topk"`` the k smallest elements are selected by
+    ``lax.top_k`` over negated total-order keys and gathered from ``x`` by
+    index.  Bit-identity argument:
+
+    - equal keys are only produced by elements the sort comparator calls
+      equal (identical bit patterns, the ±0.0 pair, or any two NaNs);
+    - ``lax.top_k`` breaks ties by lowest index first — the same order a
+      *stable* ascending sort leaves equal elements in;
+    - the gather returns the ORIGINAL elements (NaN payloads and zero
+      signs included), exactly as ``jnp.sort`` moves originals.
+
+    So every selected position holds the same bits the sorted prefix
+    would.  Pinned adversarially (NaN payloads/signs, ±inf, −0.0, heavy
+    ties) by tests/test_selection_medians.py.
+    """
+    if mode is None:
+        mode = median_select_mode()
+    size = x.shape[-1]
+    if mode == "sort" or k >= size:
+        return jnp.sort(x, axis=-1)[..., :k]
+    _neg, idx = jax.lax.top_k(-_totalorder_keys(x), k)
+    return jnp.take_along_axis(x, idx, axis=-1)
+
+
+def masked_median(x: jnp.ndarray, valid: jnp.ndarray, axis: int,
+                  mode: str | None = None):
     """Median over valid entries along ``axis`` (np.ma.median semantics).
 
-    Returns (median, n_valid); median is NaN where n_valid == 0.  Sort with
-    +inf padding, then count-based middle selection with even-count
-    averaging.
+    Returns (median, n_valid); median is NaN where n_valid == 0.  +inf
+    padding at invalid entries, then count-based middle selection with
+    even-count averaging.  Both selected positions sit in the first
+    ``size//2 + 1`` sorted elements (lo = (n−1)//2 ≤ hi = n//2 ≤ size//2),
+    so only that prefix is ever materialised (:func:`sort_prefix`).
     """
     x = jnp.moveaxis(x, axis, -1)
     valid = jnp.moveaxis(valid, axis, -1)
     size = x.shape[-1]
     filled = jnp.where(valid, x, jnp.inf)
-    srt = jnp.sort(filled, axis=-1)
+    srt = sort_prefix(filled, size // 2 + 1, mode=mode)
     n = jnp.sum(valid, axis=-1)
     lo = jnp.clip((n - 1) // 2, 0, size - 1)
     hi = jnp.clip(n // 2, 0, size - 1)
@@ -51,3 +153,27 @@ def nan_propagating_median(x: jnp.ndarray, axis: int) -> jnp.ndarray:
     hi = jnp.take(srt, size // 2, axis=axis)
     med = (lo + hi) * 0.5
     return jnp.where(jnp.isnan(x).any(axis=axis), jnp.nan, med)
+
+
+def median4_nonneg(x: jnp.ndarray) -> jnp.ndarray:
+    """``nan_propagating_median(x, axis=0)`` for a 4-row stack of
+    NON-NEGATIVE-or-NaN data, as a sort-free selection network.
+
+    The median of 4 averages the two middle *elements*; a 2-comparator
+    min/max network selects them exactly: with (a,b) = minmax(x0,x1) and
+    (c,d) = minmax(x2,x3), the middle pair is (max(a,c), min(b,d)).  The
+    network's only tie ambiguity is which of two *comparator-equal*
+    elements it picks — bit-identical anyway except for the ±0.0 pair and
+    NaN payloads, which is why the domain is constrained: callers feed
+    post-|·| data (no −0.0 exists downstream of an abs), and any NaN row
+    is overridden to NaN by the same any-NaN rule as the sort path, so
+    payload picks never surface.  The hot final combine (ops/stats.py)
+    runs this on every platform: elementwise VPU ops replacing the one
+    remaining cross-diagnostic sort launch.
+    """
+    a = jnp.minimum(x[0], x[1])
+    b = jnp.maximum(x[0], x[1])
+    c = jnp.minimum(x[2], x[3])
+    d = jnp.maximum(x[2], x[3])
+    med = (jnp.maximum(a, c) + jnp.minimum(b, d)) * 0.5
+    return jnp.where(jnp.isnan(x).any(axis=0), jnp.nan, med)

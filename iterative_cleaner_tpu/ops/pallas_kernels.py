@@ -243,7 +243,7 @@ def use_interpret() -> bool:
     return _platform() != "tpu"
 
 
-def pallas_route_status(nbin: int) -> tuple[bool, str]:
+def pallas_route_status(nbin: int, platform: str | None = None) -> tuple[bool, str]:
     """Whether the Pallas route should be taken, WITH the reason when not
     (trace-time check; bench surfaces the string in ``pallas.skipped`` and
     the runtime warnings quote it).
@@ -256,8 +256,13 @@ def pallas_route_status(nbin: int) -> tuple[bool, str]:
     - CPU: yes — interpret mode, the test harness for the kernel body.
     - anything else (GPU): no — interpret mode there would be a silent
       orders-of-magnitude slowdown, not an optimisation.
+
+    ``platform`` overrides the live-platform read: bench.py asks "what
+    WOULD a TPU say for this shape" from the CPU harness, so the
+    viability claim at the bench config stays visible without hardware.
     """
-    platform = _platform()
+    if platform is None:
+        platform = _platform()
     if platform == "cpu":
         return True, "cpu: interpret-mode kernel-body harness"
     if platform != "tpu":
@@ -281,3 +286,32 @@ def pallas_route_status(nbin: int) -> tuple[bool, str]:
 def pallas_route_ok(nbin: int) -> bool:
     """Bare-bool view of :func:`pallas_route_status` (routing call sites)."""
     return pallas_route_status(nbin)[0]
+
+
+def resolve_use_pallas(cfg, nbin: int, want_residual: bool = False) -> bool:
+    """The ``use_pallas`` static every route actually dispatches with.
+
+    ``cfg.pallas`` is tri-state since r06:
+
+    - ``None`` (the default) — AUTO: the compiled megakernel wherever it
+      is a real optimisation, i.e. on TPU when :func:`pallas_route_status`
+      says the shape is viable and the request allows it (no residual —
+      the kernel never materialises the cube; no x64 — Mosaic has no
+      f64).  Off-TPU auto resolves False: interpret mode is a test
+      harness, not a route (the CPU fuzz corpus still pins the kernel's
+      mask parity by forcing ``pallas=True``).
+    - ``True`` — forced on: resolves True whenever the *request* allows
+      it (the residual/x64 fallbacks mirror clean_cube's); a non-viable
+      shape still falls back inside the step with a warning quoting the
+      route status, exactly as before.
+    - ``False`` — forced off.
+
+    Shared by all four routes AND the compile-cache keying
+    (utils/compile_cache.inmemory_route_key) so routing and accounting
+    can never disagree.
+    """
+    if want_residual or getattr(cfg, "x64", False):
+        return False
+    if cfg.pallas is None:
+        return (not use_interpret()) and pallas_route_ok(nbin)
+    return bool(cfg.pallas)

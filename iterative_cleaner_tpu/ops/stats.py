@@ -34,7 +34,13 @@ import string
 import jax
 import jax.numpy as jnp
 
-from iterative_cleaner_tpu.ops.masked import masked_median, nan_propagating_median
+from iterative_cleaner_tpu.ops.masked import (
+    masked_median,
+    median4_nonneg,
+    median_select_mode,
+    nan_propagating_median,
+    sort_prefix,
+)
 
 # numpy.ma's default float fill value — the raw data np.ma.ptp leaves at
 # fully-masked positions (only reachable for already-zapped profiles).
@@ -109,10 +115,34 @@ def _select_medians(filled: jnp.ndarray, n: jnp.ndarray, ax3: int):
     per-line valid count — is 0).  Row 3 carries raw values and uses plain
     np.median semantics: static middle pair, NaN if any NaN is present in
     the row along the axis.
+
+    This full-sort form is the REFERENCE lowering (and the oracle for
+    tests/test_selection_medians.py); the production `_scale_axis` goes
+    through :func:`_select_medians_via`, which swaps the sort for a
+    bit-identical k-th order-statistic selection when the platform's
+    ``median_select_mode()`` says so.
     """
+    return _select_medians_via(filled, n, ax3, mode="sort")
+
+
+def _select_medians_topk(filled: jnp.ndarray, n: jnp.ndarray, ax3: int):
+    """The selection lowering of :func:`_select_medians` — forced ``topk``
+    regardless of platform (the TPU production path; the bit-identity
+    property suite runs it on the CPU harness)."""
+    return _select_medians_via(filled, n, ax3, mode="topk")
+
+
+def _select_medians_via(filled: jnp.ndarray, n: jnp.ndarray, ax3: int,
+                        mode: str | None = None):
+    """Shared body of the two lowerings above.  Every selected position
+    (lo = (n−1)//2, hi = n//2, and row 3's static middle pair) sits inside
+    the first ``size//2 + 1`` ascending elements, so only that prefix is
+    materialised — a full sort under ``mode="sort"``, a ``lax.top_k``
+    selection over total-order keys under ``mode="topk"`` (bit-identical
+    by element selection: ops/masked.sort_prefix)."""
     size = filled.shape[ax3]
     x = jnp.moveaxis(filled, ax3, -1)            # (4, A, size)
-    srt = jnp.sort(x, axis=-1)
+    srt = sort_prefix(x, size // 2 + 1, mode=mode)
     lo = jnp.clip((n - 1) // 2, 0, size - 1)     # (A,)
     hi = jnp.clip(n // 2, 0, size - 1)
     idx = jnp.stack((lo, hi), axis=-1)[None]     # (1, A, 2)
@@ -128,21 +158,24 @@ def _scale_axis(stack4: jnp.ndarray, valid: jnp.ndarray,
                 axis: int, thresh: float) -> jnp.ndarray:
     """All four diagnostics robust-scaled along 2-D ``axis`` — the batched
     production form of :func:`scale_masked` (rows 0-2) + :func:`scale_plain`
-    (row 3), two sorts of a (4, nsub, nchan) stack instead of eight separate
-    ones.  Per-row sorting and selection are independent, so each row is
-    bit-identical to its reference implementation.
+    (row 3), two median selections over a (4, nsub, nchan) stack instead of
+    eight separate sorts (full sort or ``lax.top_k`` order-statistic
+    selection per ``median_select_mode()`` — bit-identical either way).
+    Per-row selection is independent, so each row is bit-identical to its
+    reference implementation.
     """
     ax3 = axis + 1
     n = jnp.sum(valid, axis=axis)
     valid3 = valid[None]
+    mode = median_select_mode()
     filled = jnp.concatenate(
         (jnp.where(valid3, stack4[:3], jnp.inf), stack4[3:]), axis=0)
-    med = _select_medians(filled, n, ax3)
+    med = _select_medians_via(filled, n, ax3, mode=mode)
     r = stack4 - jnp.expand_dims(med, ax3)
     abs_r = jnp.abs(r)
     filled_r = jnp.concatenate(
         (jnp.where(valid3, abs_r[:3], jnp.inf), abs_r[3:]), axis=0)
-    mad = _select_medians(filled_r, n, ax3)
+    mad = _select_medians_via(filled_r, n, ax3, mode=mode)
 
     has = n > 0                                   # (A,)
     madA, madB = mad[:3], mad[3]
@@ -283,16 +316,25 @@ def scale_and_combine(
 ) -> jnp.ndarray:
     """Robust-scale the four diagnostics and combine (reference :220-224).
 
-    All four diagnostics are stacked so each axis needs TWO sorts of one
-    (4, nsub, nchan) array (values, then absolute deviations) instead of
-    eight separate ones — r03 phase telemetry put the scalers at ~44% of
-    the device step, dominated by sort launches.  Rows sort and select
-    independently (type-A count-based selection for the masked rows, plain
-    np.median semantics for the mask-blind FFT row), so every row is
-    bit-identical to its unbatched reference implementation above.
+    All four diagnostics are stacked so each axis needs TWO median
+    selections over one (4, nsub, nchan) array (values, then absolute
+    deviations) instead of eight separate ones — r03 phase telemetry put
+    the scalers at ~44% of the device step, dominated by sort launches,
+    and r06 replaced the remaining full sorts with k-th order-statistic
+    selection (`_select_medians_via`; bit-identical by element selection).
+    Rows select independently (type-A count-based selection for the masked
+    rows, plain np.median semantics for the mask-blind FFT row), so every
+    row is bit-identical to its unbatched reference implementation above.
+
+    The final cross-diagnostic median runs as a sort-free selection
+    network (`median4_nonneg`): ``combined`` is non-negative-or-NaN by
+    construction (every row is |·| or |·|/thresh), which is exactly the
+    domain where the network is bit-identical to the sort-based
+    `nan_propagating_median` — the one launch the stack trick could not
+    batch away.
     """
     stack4 = jnp.stack((d_std, d_mean, d_ptp, d_fft), axis=0)
     per_chan = _scale_axis(stack4, valid, axis=0, thresh=chanthresh)
     per_subint = _scale_axis(stack4, valid, axis=1, thresh=subintthresh)
     combined = jnp.maximum(per_chan, per_subint)  # mask-drop (§8.L2)
-    return nan_propagating_median(combined, axis=0)
+    return median4_nonneg(combined)

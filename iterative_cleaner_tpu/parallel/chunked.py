@@ -184,8 +184,13 @@ class ChunkedJaxCleaner:
                                                   # pass (not sparse-updated)
         self.template_passes = 0   # observability: full streamed template
                                    # accumulations (cube uploads) so far
-        self._use_pallas = False
-        if cfg.pallas:
+        from iterative_cleaner_tpu.ops.pallas_kernels import resolve_use_pallas
+
+        # Tri-state cfg.pallas (None = auto: the megakernel wherever it is a
+        # real optimisation); the explicit-True-but-not-viable case keeps its
+        # warning + XLA fallback.
+        self._use_pallas = resolve_use_pallas(cfg, self._D.shape[-1])
+        if self._use_pallas:
             from iterative_cleaner_tpu.ops.pallas_kernels import (
                 pallas_route_status,
             )

@@ -33,13 +33,28 @@ def batched_clean_step(Db, w0b, validb, w_prevb, chanthresh, subintthresh, *, pu
     return jax.vmap(fn)(Db, w0b, validb, w_prevb)
 
 
-@partial(jax.jit, static_argnames=("max_iter", "pulse_region"))
-def batched_fused_clean(Db, w0b, validb, chanthresh, subintthresh, *, max_iter, pulse_region):
+@partial(jax.jit, static_argnames=("max_iter", "pulse_region", "use_pallas"))
+def batched_fused_clean(Db, w0b, validb, chanthresh, subintthresh, *,
+                        max_iter, pulse_region, use_pallas=False):
     """Whole convergence loop for a batch (vmapped lax.while_loop: runs until
-    every archive in the batch has converged or hit max_iter)."""
+    every archive in the batch has converged or hit max_iter).
+
+    ``use_pallas`` routes each archive's stats phase through the fused
+    megakernel (pallas_call has a vmap batching rule: the archive axis
+    becomes a leading grid dimension).  It stays OFF for mesh-sharded
+    dispatches by policy, not oversight: GSPMD cannot partition an opaque
+    ``pallas_call`` custom call, so a sharded operand would be all-gathered
+    to every device first — re-materialising the full cube is exactly what
+    the sharded route exists to avoid (the same static-analysis argument
+    that keeps fft_diagnostic custom-partitioned, and why
+    ``test_sharded_lowering_never_gathers_the_cube`` would fail).  A
+    future shard_map wrapper is the clean unlock; until then the sharded
+    route's resolver never turns it on, and CleanConfig still rejects an
+    explicit ``pallas=True, sharded_batch=True``.
+    """
     fn = lambda D, w0, v: fused_clean(
         D, w0, v, chanthresh, subintthresh,
-        max_iter=max_iter, pulse_region=pulse_region)
+        max_iter=max_iter, pulse_region=pulse_region, use_pallas=use_pallas)
     return jax.vmap(fn)(Db, w0b, validb)
 
 

@@ -30,10 +30,14 @@ def inmemory_route_key(shape, cfg, want_residual: bool) -> tuple:
     shared by clean_cube's accounting and the precompile warm path so the
     two can never disagree.  ``cfg`` must be the raw user config: the
     pallas/incremental residual fallbacks are applied here, exactly as
-    clean_cube resolves them before keying."""
+    clean_cube resolves them before keying (pallas through the shared
+    tri-state resolver, so the auto default keys the executable that
+    actually compiles on this platform)."""
+    from iterative_cleaner_tpu.ops.pallas_kernels import resolve_use_pallas
+
     nsub, nchan, nbin = shape
     pr = tuple(cfg.pulse_region)
-    pallas = cfg.pallas and not want_residual
+    pallas = resolve_use_pallas(cfg, nbin, want_residual)
     incremental = cfg.incremental_template and not want_residual
     if cfg.fused:
         # fused_clean statics: max_iter, pulse_region, want_residual,

@@ -73,6 +73,24 @@ def test_jax_matches_numpy_fuzzed(seed):
     assert res_np.converged == res_jx.converged == res_fu.converged
 
 
+@pytest.mark.parametrize("seed", range(50, 53))
+def test_pallas_megakernel_matches_numpy_fuzzed(seed):
+    """The Pallas stats megakernel (forced on; interpret mode on the CPU
+    harness — the same kernel body the TPU auto-default compiles) joins the
+    fuzz matrix: fused loop + megakernel vs the oracle, plus the stepwise
+    megakernel route."""
+    archive, kw = draw_case(seed)
+    D, w0 = preprocess(archive)
+    res_np = clean_cube(D, w0, CleanConfig(backend="numpy", **kw))
+    res_pl = clean_cube(D, w0, CleanConfig(backend="jax", fused=True,
+                                           pallas=True, **kw))
+    res_ps = clean_cube(D, w0, CleanConfig(backend="jax", pallas=True, **kw))
+    np.testing.assert_array_equal(res_np.weights, res_pl.weights)
+    np.testing.assert_array_equal(res_np.weights, res_ps.weights)
+    assert res_np.loops == res_pl.loops == res_ps.loops
+    assert res_np.converged == res_pl.converged == res_ps.converged
+
+
 @pytest.mark.parametrize("seed", range(20, 23))
 def test_multipol_matches_numpy_fuzzed(seed):
     # Multi-pol archives go through the pscrunch preprocess (Coherence:

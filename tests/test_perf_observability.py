@@ -398,6 +398,14 @@ def test_perf_gate_fails_on_synthetic_regressions(perf_gate, baseline,
         "parity": lambda p: p.update(parity_small_config=False),
         "error": lambda p: p.update(error="synthetic"),
         "missing_memory": lambda p: p.pop("memory"),
+        # r06: the -1 sort-counter error sentinel must FAIL the static
+        # ratchet, not trivially pass under fresh < ceiling.
+        "sort_sentinel": lambda p: p["static_analysis"].update(
+            stats_sort_ops=-1),
+        # r06: scalers phase-share collapse past SHARE_CEILING (armed by
+        # the baseline's own healthy share).
+        "share_collapse": lambda p: p["phases"]["phase_share"].update(
+            scalers=0.81),
     }
     for name, mutate in cases.items():
         payload = copy.deepcopy(baseline)

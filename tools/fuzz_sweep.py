@@ -3,10 +3,14 @@
 Draws random configurations with tests/test_fuzz_equivalence.py's generator
 and demands bit-identical final masks between the numpy oracle and every JAX
 execution mode — stepwise, fused, chunked (random block, both the pipelined
-ingest default and the ICT_INGEST_DEPTH=1 serial path), the 8-device
-sharded path, and the streaming-ingest online route (random block splits,
-canonical finalize) — plus loop-count agreement.  Any failing seed is
-reproducible directly in the CI test by adding it to the parametrize range.
+ingest default and the ICT_INGEST_DEPTH=1 serial path), the Pallas stats
+megakernel (forced on; interpret mode here, the same kernel body the TPU
+auto-default compiles), the 8-device sharded path, and the streaming-ingest
+online route (random block splits, canonical finalize) — plus loop-count
+agreement.  ICT_MEDIAN_SELECT=topk re-runs the whole sweep on the selection
+lowering of the robust-scaler medians (the TPU default; sort elsewhere).
+Any failing seed is reproducible directly in the CI test by adding it to
+the parametrize range.
 
 Usage: python tools/fuzz_sweep.py [n_seeds] [start]
 
@@ -72,7 +76,7 @@ def main() -> int:
         x64 = bool(jax.config.jax_enable_x64)
         modes = {}
         mode_cfgs = {}
-        for name, cfg in (
+        mode_list = [
             # stepwise/fused/chunked run the r04 incremental-template
             # default; each dense rebuild stays fuzzed via its own mode
             # (dense remains reachable through --no_incremental_template,
@@ -97,7 +101,15 @@ def main() -> int:
             (f"chunked_dense(b={block})",
              CleanConfig(backend="jax", chunk_block=block, x64=x64,
                          incremental_template=False, **kw)),
-        ):
+        ]
+        if not x64:
+            # The Pallas stats megakernel (forced on; interpret mode on the
+            # CPU harness — the kernel body the TPU auto-default compiles).
+            # Mosaic has no f64, so the x64 sweep excludes it by config.
+            mode_list.append(
+                ("pallas", CleanConfig(backend="jax", fused=True,
+                                       pallas=True, **kw)))
+        for name, cfg in mode_list:
             serial_ingest = name.startswith("chunked_serial")
             if serial_ingest:
                 # Force serial for this mode only, restoring whatever the

@@ -23,11 +23,21 @@ What is gated, and why it is non-flaky on shared CI runners:
   below baseline / ``--ratio-tolerance`` (default 3x — generous, catches
   the order-of-magnitude regressions that matter);
 - **static memory traffic** (``static_analysis``: dense / incremental /
-  fused bytes-per-cube, and the chunked streaming stats pass's
-  bytes-per-slab): XLA's own cost model, fully deterministic on a
-  pinned jax version, gated tight (``--static-tolerance``, default 1.15)
-  — a kernel change that re-reads the cube shows up here with zero noise;
-  and the incremental route must keep saving traffic over the dense one;
+  fused bytes-per-cube, the chunked streaming stats pass's
+  bytes-per-slab, and — r06 — the in-memory stats phase's bytes, the
+  scalers' map-unit bytes, the optimized-HLO sort-launch count, and the
+  two step cube-pass model sums): XLA's own cost model, fully
+  deterministic on a pinned jax version, gated tight
+  (``--static-tolerance``, default 1.15) — a kernel change that re-reads
+  the cube shows up here with zero noise; and the incremental route must
+  keep saving traffic over the dense one;
+- **scalers phase share** (r06): ``phases.phase_share.scalers`` — the
+  fraction of the unfused step spent in the robust scalers, an intra-run
+  ratio the selection medians shrank; armed when the baseline itself
+  demonstrated a sub-ceiling share, it fails only on a collapse past the
+  fixed ``SHARE_CEILING`` (the OVERLAP_COLLAPSE pattern: the threshold
+  sits far above measured load noise and below the sort-bound failure
+  mode);
 - **ingest contract**: the ``ingest`` block must exist with an
   ``overlap_efficiency`` figure, the wire codec's round-trip must be
   bit-exact, the upload/compute overlap must not COLLAPSE (below 0.25 —
@@ -74,14 +84,25 @@ DEFAULT_HISTORY = os.path.join(REPO, "docs", "bench_history.jsonl")
 #: wedged-tunnel hang — out of the child entirely.
 GATE_ENV = {
     "JAX_PLATFORMS": "cpu",
-    "BENCH_NSUB": "16",
-    "BENCH_NCHAN": "64",
-    "BENCH_NBIN": "128",
+    # 32x128x256 since r06 (was 16x64x128): the scalers phase share is now
+    # ratcheted, and at the old shape the whole unfused step was ~7 ms —
+    # noise-dominated deltas, shares that did not even sum to 1.  One notch
+    # up puts the step at tens of ms (shares reproducible to a few percent)
+    # while numpy's full clean stays ~a second on a CI runner.
+    "BENCH_NSUB": "32",
+    "BENCH_NCHAN": "128",
+    "BENCH_NBIN": "256",
     "BENCH_MAX_ITER": "3",
     "BENCH_SKIP_NORTHSTAR": "1",
-    "BENCH_SKIP_PALLAS": "1",
+    # The pallas section short-circuits off-TPU into its skip record, which
+    # since r06 carries the would-be-TPU viability status for the gate
+    # shape — zero timing cost, and the payload documents the claim.
+    "BENCH_SKIP_PALLAS": "0",
     "BENCH_SKIP_CHUNKED": "1",
-    "BENCH_SKIP_PHASES": "1",
+    # Phases ON since r06: the scalers phase share is ratcheted (the
+    # selection-median work's acceptance figure), so the gate config must
+    # measure real phase boundaries.
+    "BENCH_SKIP_PHASES": "0",
     "BENCH_MIRROR": "0",
     "BENCH_WATCHDOG_S": "900",
     "ICT_NO_COMPILE_CACHE": "1",
@@ -91,11 +112,20 @@ GATE_ENV = {
 RATIO_KEYS = ("end_to_end_speedup_warm", "per_iteration_speedup")
 
 #: Deterministic XLA cost-model keys under static_analysis (lower is
-#: better, in cube/block-sized units).  chunked_stats_bytes_cubes is the
-#: streaming stats pass the ingest pipeline feeds — the "fused stats pass"
-#: bytes-per-slab figure the ingest tentpole ratchets.
+#: better, in cube/block/map-sized units).  chunked_stats_bytes_cubes is
+#: the streaming stats pass the ingest pipeline feeds — the "fused stats
+#: pass" bytes-per-slab figure the ingest tentpole ratchets.  The r06
+#: additions: stats_bytes_cubes (the in-memory stats phase),
+#: scalers_bytes_maps (the robust scalers, map units — they never touch
+#: the cube), stats_sort_ops (optimized-HLO sort launches — the r05
+#: profile was sort-launch dominated, so a reappearing sort is the
+#: regression), and the two step cube-pass MODEL sums (zero-noise
+#: constants; a kernel change that re-reads the cube must bump the model
+#: loudly and fails here until the baseline moves with it).
 STATIC_KEYS = ("step_dense_bytes_cubes", "step_incremental_bytes_cubes",
-               "fused_bytes_cubes", "chunked_stats_bytes_cubes")
+               "fused_bytes_cubes", "chunked_stats_bytes_cubes",
+               "stats_bytes_cubes", "scalers_bytes_maps", "stats_sort_ops",
+               "step_cube_passes_model_xla", "step_cube_passes_model_pallas")
 
 #: Blocks bench.py promises on every exit path since the obs layer landed
 #: ("ingest" since the ingest tier: upload-pipeline + wire-codec
@@ -106,6 +136,19 @@ REQUIRED_KEYS = ("metric", "value", "unit", "vs_baseline",
 #: The tentpole's acceptance bar: the baseline must have demonstrated
 #: >= 50% upload/compute overlap for the floor check to arm at all.
 OVERLAP_FLOOR = 0.5
+
+#: Phase-share ratchet (r06): the scalers share of the unfused step —
+#: selection medians + the median-of-4 network are the win the ratchet
+#: protects.  Shares are intra-run ratios (machine speed cancels), but the
+#: deltas are differences of stage minima at a tens-of-ms step, and loaded
+#: shared runners were measured swinging a healthy ~0.35 share anywhere
+#: between ~0.25 and ~0.55 — baseline and fresh alike — so the check is
+#: built exactly like the overlap one: it ARMS when the baseline itself
+#: demonstrated a healthy share (< the ceiling) and FAILS only on a
+#: collapse past the fixed ceiling.  Losing the win (the r05 state: fft
+#: time absorbed into a sort-launch-bound scalers phase) reads ≥ ~0.7;
+#: load noise alone was never observed past 0.55.
+SHARE_CEILING = 0.68
 
 #: What actually FAILS the gate once armed: an overlap collapse.  The
 #: stall-based metric (ingest/pipeline.py) measures protocol behavior,
@@ -225,6 +268,27 @@ def compare(payload: dict, baseline: dict, ratio_tolerance: float,
                 f"{base_ledger!r} (zero tolerance — update the baseline "
                 f"only together with an intentional ROUTE_DONATIONS change)")
 
+    # Phase-share ratchet: armed whenever the baseline's own phase profile
+    # demonstrated a healthy (sub-ceiling) scalers share.
+    base_share = ((baseline.get("phases") or {}).get("phase_share")
+                  or {}).get("scalers")
+    if isinstance(base_share, (int, float)) and base_share < SHARE_CEILING:
+        fresh_phases = payload.get("phases")
+        fresh_share = ((fresh_phases or {}).get("phase_share")
+                       or {}).get("scalers")
+        if not isinstance(fresh_share, (int, float)):
+            problems.append(
+                "phases.phase_share.scalers missing from payload "
+                f"(baseline has {base_share}) — the phase profile the "
+                "scalers ratchet reads did not run")
+        elif fresh_share > SHARE_CEILING:
+            problems.append(
+                f"scalers phase share collapsed: {fresh_share:.3f} of the "
+                f"unfused step > the {SHARE_CEILING} ceiling (baseline "
+                f"{base_share:.3f}) — the selection-median win is gone "
+                "(fft time absorbed back into a sort-bound scalers phase "
+                "reads >= ~0.7; load noise was never observed past ~0.55)")
+
     for key in RATIO_KEYS:
         base = baseline.get(key)
         fresh = payload.get(key)
@@ -246,7 +310,20 @@ def compare(payload: dict, baseline: dict, ratio_tolerance: float,
         for key in STATIC_KEYS:
             base = sa_base.get(key)
             fresh = sa_fresh.get(key)
-            if not isinstance(base, (int, float)) or base <= 0:
+            if not isinstance(base, (int, float)):
+                continue
+            if isinstance(fresh, (int, float)) and (fresh < 0 or base < 0):
+                # bench's sort_ops() counter reports -1 when the HLO text
+                # is unavailable; a ratchet whose input errored must fail
+                # loudly, not disarm (fresh=-1 would trivially pass the
+                # ceiling while a reappearing sort launch goes unseen).
+                problems.append(
+                    f"static_analysis.{key} carries an error sentinel "
+                    f"(fresh {fresh}, baseline {base}) — the bench counter "
+                    "errored; fix it (or move the baseline deliberately) "
+                    "instead of running with this ratchet disarmed")
+                continue
+            if base <= 0:
                 continue
             if not isinstance(fresh, (int, float)):
                 problems.append(f"static_analysis.{key} missing from payload "
@@ -273,6 +350,9 @@ def history_line(payload: dict, ok: bool) -> dict:
     sa = payload.get("static_analysis") or {}
     ing = payload.get("ingest") or {}
     return {
+        "scalers_phase_share": ((payload.get("phases") or {})
+                                .get("phase_share") or {}).get("scalers"),
+        "unfused_step_s": (payload.get("phases") or {}).get("unfused_step_s"),
         "ingest_overlap_efficiency": ing.get("overlap_efficiency"),
         "ingest_codec_ratio": ing.get("codec_ratio"),
         "ts": round(time.time(), 3),
