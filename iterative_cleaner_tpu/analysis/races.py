@@ -1,7 +1,9 @@
-"""ICT007/ICT008: static race detection for ``service/`` and ``obs/``.
+"""ICT007/ICT008: static race detection for ``service/``, ``obs/``, and
+``fleet/``.
 
 The serving daemon runs five-plus concurrent threads (loaders, tick,
-dispatch worker, shadow auditor, HTTP request threads) over shared state
+dispatch worker, shadow auditor, HTTP request threads) — and the fleet
+router adds its poll loop plus its own HTTP request threads — over shared state
 that lives in two shapes: module globals (the obs registries) and
 attributes of lock-owning classes (scheduler buckets, the job index).
 This detector makes the locking discipline *checkable*:
@@ -48,6 +50,7 @@ from iterative_cleaner_tpu.analysis.rules import dotted_name
 RACE_SCOPE_PREFIXES = (
     "iterative_cleaner_tpu/service/",
     "iterative_cleaner_tpu/obs/",
+    "iterative_cleaner_tpu/fleet/",
 )
 
 LOCK_FACTORIES = {"Lock", "RLock"}

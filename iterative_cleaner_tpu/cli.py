@@ -224,6 +224,13 @@ def main(argv: list[str] | None = None) -> int:
         from iterative_cleaner_tpu.service.daemon import serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "serve-fleet" and not os.path.isfile("serve-fleet"):
+        # The fleet router in front of N daemon replicas (docs/SERVING.md
+        # "Fleet"); same literal-token dispatch rule as ``serve``, and
+        # ``ict-serve-fleet`` is the unambiguous script entry point.
+        from iterative_cleaner_tpu.fleet.router import fleet_main
+
+        return fleet_main(argv[1:])
     args = build_parser().parse_args(argv)
     try:
         cfg = config_from_args(args)

@@ -1,0 +1,32 @@
+"""ict-fleet: the front-end router + replica-aware serving tier.
+
+One :class:`~.daemon.CleaningService` replica serves one host; survey-scale
+real-time RFI mitigation is a *fleet* problem (arXiv:1701.08197), so this
+package puts a router process in front of N daemon replicas:
+
+- :mod:`.client`   — stdlib-HTTP client for the replica API (/healthz,
+                     /jobs, /drain) with transport-vs-HTTP error split
+- :mod:`.registry` — replica records + health polling: liveness, drain
+                     flags, per-shape-bucket queue depths, warm shapes
+- :mod:`.tenants`  — multi-tenant admission: per-tenant quotas (429 +
+                     Retry-After on breach) and weighted fair queueing
+                     over placement order under contention
+- :mod:`.router`   — the FleetRouter daemon: least-loaded-by-bucket
+                     placement with a warm-cache affinity bonus, failover
+                     re-routing with idempotency keys (a job never runs
+                     twice on one replica), its own Prometheus /metrics,
+                     and the ``serve-fleet`` CLI (+ ``--smoke``)
+
+The router is routing, not math: every mask is produced by a replica,
+and replicas stay bit-identical to the numpy oracle on every route
+(docs/SERVING.md "Fleet").  Zero new dependencies — the router is the
+same stdlib ``http.server`` + ``urllib`` stack the replicas use.
+"""
+
+from iterative_cleaner_tpu.fleet.client import ReplicaClient
+from iterative_cleaner_tpu.fleet.registry import ReplicaRegistry
+from iterative_cleaner_tpu.fleet.router import FleetConfig, FleetRouter
+from iterative_cleaner_tpu.fleet.tenants import TenantAdmission
+
+__all__ = ["ReplicaClient", "ReplicaRegistry", "FleetConfig", "FleetRouter",
+           "TenantAdmission"]

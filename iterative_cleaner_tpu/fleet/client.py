@@ -1,0 +1,90 @@
+"""Stdlib-HTTP client for the replica API, used by the fleet router.
+
+The one design point is the error split: a **transport** failure
+(connection refused, DNS, socket timeout — the replica may be dead) is
+:class:`ReplicaUnreachable`, while an **HTTP** error (the replica is
+alive and said no: 503 at the admission cap or draining, 400 for a bad
+path) is :class:`ReplicaRefused` with the status attached.  The router's
+failover ladder keys on exactly that distinction — transport failures
+count toward declaring a replica dead and re-routing its jobs; refusals
+never do (a draining replica answering 503 is *healthy*).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+#: Default per-request timeout for router -> replica calls.  Small: the
+#: router's placement path blocks a client submission on it, and a
+#: wedged replica should fail over in seconds, not minutes.
+DEFAULT_TIMEOUT_S = 10.0
+
+
+class ReplicaUnreachable(RuntimeError):
+    """Transport-level failure: nothing answered (or the answer never
+    arrived).  Counts toward the registry's death threshold."""
+
+
+class ReplicaRefused(RuntimeError):
+    """The replica answered with an HTTP error status; it is alive."""
+
+    def __init__(self, status: int, body: dict) -> None:
+        super().__init__(f"replica refused ({status}): "
+                         f"{body.get('error', '')!s}")
+        self.status = int(status)
+        self.body = body
+
+
+class ReplicaClient:
+    """Thin JSON-over-HTTP client; one instance is shared by the router's
+    handler threads and the poll loop (it holds no mutable state)."""
+
+    def __init__(self, timeout_s: float = DEFAULT_TIMEOUT_S) -> None:
+        self.timeout_s = float(timeout_s)
+
+    def _call(self, url: str, body: dict | None = None,
+              headers: dict | None = None) -> dict:
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(url, data=data, headers={
+            **({"Content-Type": "application/json"} if data else {}),
+            **(headers or {})})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                payload = json.load(resp)
+        except urllib.error.HTTPError as exc:
+            # The replica spoke HTTP: parse its JSON error envelope if it
+            # sent one (it always does), keep the status either way.
+            try:
+                detail = json.load(exc)
+                if not isinstance(detail, dict):
+                    detail = {"error": str(detail)}
+            except ValueError:
+                detail = {"error": exc.reason}
+            raise ReplicaRefused(exc.code, detail) from exc
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError) as exc:
+            raise ReplicaUnreachable(f"{url}: {exc}") from exc
+        if not isinstance(payload, dict):
+            raise ReplicaUnreachable(f"{url}: non-object JSON reply")
+        return payload
+
+    # --- the replica surface the router speaks ---
+
+    def health(self, base_url: str) -> dict:
+        return self._call(f"{base_url}/healthz")
+
+    def submit(self, base_url: str, payload: dict,
+               trace_id: str = "") -> dict:
+        """POST /jobs on one replica; the trace context crosses the hop in
+        the X-ICT-Trace header (the replica adopts it instead of minting),
+        so the event log threads placement -> dispatch under one id."""
+        headers = {"X-ICT-Trace": trace_id} if trace_id else None
+        return self._call(f"{base_url}/jobs", body=payload, headers=headers)
+
+    def job(self, base_url: str, job_id: str) -> dict:
+        return self._call(f"{base_url}/jobs/{job_id}")
+
+    def drain(self, base_url: str, flag: bool = True) -> dict:
+        return self._call(f"{base_url}/drain", body={"drain": bool(flag)})

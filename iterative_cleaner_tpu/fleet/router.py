@@ -1,0 +1,1202 @@
+"""The fleet router: one front door for N cleaning-daemon replicas.
+
+Placement policy (docs/SERVING.md "Fleet"):
+
+- **least-loaded-by-bucket** — candidates are ranked by the scalar load
+  off their last ``/healthz`` snapshot (open jobs + every queue depth +
+  placements routed since that snapshot), minus a **warm-cache affinity
+  bonus** when the submission declares its shape bucket (optional
+  ``"shape": [nsub, nchan, nbin]`` in the POST body): a replica whose
+  warm pool holds the bucket's executables — or that already has cubes
+  of that bucket queued — is preferred, because on it the job compiles
+  nothing;
+- **drain/death eviction** — a draining replica (``/healthz`` says
+  ``draining: true``) or a dead one (``dead_after`` consecutive
+  unreachable polls) gets no new placements; a dead replica's open
+  placements are **re-routed** to surviving replicas carrying the same
+  idempotency key, so the job runs at most once per replica and the
+  fleet serves it exactly once while the dead replica stays dead;
+- **failover retries** — submission-path transport failures walk the
+  remaining candidates, then back off with **full jitter**
+  (utils/backoff.py; ``ICT_BACKOFF_SEED`` pins schedules in tests) so N
+  routers (or one router's N queued failovers) recovering from the same
+  incident don't thundering-herd the revived replica;
+- **multi-tenant admission** — per-tenant open-placement quotas (429 +
+  ``Retry-After`` on breach) and weighted fair queueing over placement
+  grants when submissions contend for the ``--max_inflight`` budget
+  (fleet/tenants.py; ``X-ICT-Tenant`` header, absent -> "default").
+
+The router is just another stdlib-HTTP daemon — ``serve-fleet`` on the
+CLI, ``ThreadingHTTPServer`` + ``urllib`` inside, zero new dependencies
+— and it exposes its own ``/metrics`` (placements, failovers, per-tenant
+admissions/rejections, per-replica queue-depth gauges) so the obs tower
+sees the fleet as one system.  Trace context crosses the hop: the
+router forwards ``X-ICT-Trace`` on proxied submissions and emits
+``fleet_placement`` / ``fleet_failover`` events into the event log and
+the flight ring.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from iterative_cleaner_tpu.fleet.client import (
+    ReplicaClient,
+    ReplicaRefused,
+    ReplicaUnreachable,
+)
+from iterative_cleaner_tpu.fleet.registry import Replica, ReplicaRegistry
+from iterative_cleaner_tpu.fleet.tenants import (
+    DEFAULT_TENANT,
+    QuotaExceeded,
+    TenantAdmission,
+    WeightedFairQueue,
+)
+from iterative_cleaner_tpu.obs import events
+from iterative_cleaner_tpu.obs.metrics import _fmt, _labels
+from iterative_cleaner_tpu.service.scheduler import bucket_label
+from iterative_cleaner_tpu.utils import backoff
+
+#: Placement-score bonus for a replica whose warm pool already holds the
+#: submission's shape bucket (it will compile nothing), and the smaller
+#: bonus for one that merely has the bucket queued (its compile is paid
+#: or in flight).  Units are "queued cubes": a warm replica wins ties
+#: and small load deficits, but a deeply-backlogged warm replica still
+#: loses to an idle cold one.
+AFFINITY_WARM = 2.5
+AFFINITY_QUEUED = 1.25
+
+#: Consecutive 404 status polls before an open placement is declared
+#: lost (its replica restarted with a cleared spool and genuinely does
+#: not know the job) and failed terminally.
+MISSING_POLLS_LOST = 3
+
+
+class FleetBusy(RuntimeError):
+    """No replica could take the job right now (all dead, draining, or
+    at capacity, or the placement-grant wait timed out) — HTTP 503 with
+    Retry-After, the replica admission-cap convention."""
+
+
+@dataclass
+class FleetConfig:
+    replicas: tuple = ()             # replica base URLs, e.g. http://h:8750
+    host: str = "127.0.0.1"
+    port: int = 8790                 # 0 = ephemeral (tests)
+    router_id: str = ""              # "" = mint one per process life
+    poll_interval_s: float = 1.0     # health-poll + failover-sweep cadence
+    dead_after: int = 3              # consecutive unreachable polls -> dead
+    replica_timeout_s: float = 10.0  # per router->replica HTTP call
+    max_inflight: int = 0            # fleet-wide open-placement budget
+                                     # (0 = unbounded); contention beyond it
+                                     # is arbitrated by weighted fair queueing
+    queue_timeout_s: float = 30.0    # max wait for a placement grant
+    failover_retries: int = 2        # extra candidate sweeps per submission
+    retry_backoff_s: float = 0.25    # full-jitter base between sweeps
+    placement_keep: int = 10000      # terminal placement records kept
+    tenant_quotas: dict = field(default_factory=dict)
+    tenant_weights: dict = field(default_factory=dict)
+    default_quota: int = 0           # per-tenant open-placement cap (0 = off)
+    default_weight: float = 1.0
+    telemetry: str = ""              # JSON-lines event log (obs/events)
+    quiet: bool = False
+
+
+@dataclass
+class Placement:
+    """One routed job.  ``job_id`` is the fleet-visible identity — the id
+    the FIRST accepting replica minted, which the client holds from its
+    202; after a failover the serving replica (and its inner job id)
+    change underneath while the fleet id stays stable, and proxied reads
+    rewrite the manifest back to it."""
+
+    job_id: str
+    tenant: str
+    trace_id: str
+    payload: dict                   # forwarded verbatim on re-route, with
+                                    # the idempotency key inside — the same
+                                    # key is what makes re-routes dedupe
+    base_url: str
+    replica_id: str
+    replica_job_id: str
+    state: str = "open"             # open -> done | error
+    error: str = ""
+    attempts: int = 1               # placements incl. failover re-routes
+    submitted_s: float = 0.0
+    missing_polls: int = 0          # consecutive status polls the serving
+                                    # replica answered 404 — a revived
+                                    # replica whose spool was cleared has
+                                    # genuinely lost the job, and the
+                                    # placement must fail terminally
+                                    # instead of leaking its slot forever
+
+
+def new_router_id() -> str:
+    return f"fr-{uuid.uuid4().hex[:8]}"
+
+
+class RouterMetrics:
+    """The router's own tiny metric registry, rendered as Prometheus
+    text on ``/metrics``.  Deliberately NOT the process-global
+    obs.tracing registry: fleet tests run a router and three replicas in
+    one process, and the router's counters must not bleed into (or read
+    from) the replicas' — each HTTP surface exposes exactly its own
+    process role."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # (family, ((label, value), ...)) -> float
+        self._counters: dict = {}  # ict: guarded-by(self._lock)
+        self._gauges: dict = {}  # ict: guarded-by(self._lock)
+
+    @staticmethod
+    def _key(family: str, labels: dict | None):
+        return (family, tuple(sorted((labels or {}).items())))
+
+    def count(self, family: str, labels: dict | None = None,
+              inc: float = 1.0) -> None:
+        key = self._key(family, labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + inc
+
+    def counter_value(self, family: str, labels: dict | None = None) -> float:
+        with self._lock:
+            return self._counters.get(self._key(family, labels), 0.0)
+
+    def counter_total(self, family: str) -> float:
+        with self._lock:
+            return sum(v for (fam, _), v in self._counters.items()
+                       if fam == family)
+
+    def set_gauge(self, family: str, labels: dict | None,
+                  value: float) -> None:
+        with self._lock:
+            self._gauges[self._key(family, labels)] = float(value)
+
+    def replace_gauge_family(self, family: str,
+                             entries: dict[tuple, float]) -> None:
+        """Swap every sample of one gauge family atomically — per-replica
+        and per-bucket gauges are rebuilt from each health poll, and a
+        bucket that drained (or a replica that left) must drop off the
+        exposition rather than freeze at its last value."""
+        with self._lock:
+            for key in [k for k in self._gauges if k[0] == family]:
+                del self._gauges[key]
+            for labels, value in entries.items():
+                self._gauges[(family, tuple(sorted(labels)))] = float(value)
+
+    def render(self) -> str:
+        """Prometheus text exposition; same grammar obs/metrics.py renders
+        (pinned by the strict-regex test in tests/test_fleet.py)."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+        lines: list[str] = []
+        for kind, table in (("counter", counters), ("gauge", gauges)):
+            seen: set[str] = set()
+            for (family, label_pairs) in sorted(table):
+                if family not in seen:
+                    seen.add(family)
+                    lines.append(f"# TYPE ict_{family} {kind}")
+                lines.append(f"ict_{family}{_labels(label_pairs)} "
+                             f"{_fmt(table[(family, label_pairs)])}")
+        return "\n".join(lines) + "\n"
+
+
+class _Ticket:
+    """One submission waiting for a placement grant; written only under
+    the router's placement condition lock."""
+
+    __slots__ = ("granted", "abandoned")
+
+    def __init__(self) -> None:
+        self.granted = False
+        self.abandoned = False
+
+
+class FleetRouter:
+    """Lifecycle + the placement engine.  Thread layout (all daemonic):
+    the ThreadingHTTPServer's per-request threads (submissions block in
+    the WFQ grant wait; reads are lock-snapshot cheap) and ONE poll
+    thread (health refresh, placement-status refresh, failover sweep,
+    gauge rebuild).  All shared state sits behind ``self._cond``'s lock
+    (placements, inflight budget, WFQ) or the registry's/metrics' own
+    locks — acquisition order is always router -> registry/metrics,
+    never the reverse."""
+
+    def __init__(self, cfg: FleetConfig) -> None:
+        if not cfg.replicas:
+            raise ValueError("a fleet needs at least one --replica URL")
+        self.cfg = cfg
+        self.router_id = cfg.router_id or new_router_id()
+        self.started_s = time.time()
+        self.client = ReplicaClient(timeout_s=cfg.replica_timeout_s)
+        self.registry = ReplicaRegistry(
+            [u.rstrip("/") for u in cfg.replicas],
+            dead_after=cfg.dead_after)
+        self.admission = TenantAdmission(
+            quotas=cfg.tenant_quotas, default_quota=cfg.default_quota)
+        self.metrics = RouterMetrics()
+        # RLock, deliberately: the grant pump (_grant_free_slots) takes it
+        # lexically so every _inflight mutation sits under a visible
+        # ``with self._lock:`` (the ICT007 discipline), and its callers
+        # already hold the lock when pumping after a state change.
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._wfq = WeightedFairQueue(
+            weights=cfg.tenant_weights, default_weight=cfg.default_weight)
+        self._placements: dict[str, Placement] = {}  # ict: guarded-by(self._lock)
+        # idempotency key -> fleet job id ("" while a placement carrying
+        # the key is in flight): the ROUTER-side half of the dedupe — a
+        # client retry with a pinned key must not run the job again on a
+        # DIFFERENT replica (the replica-side map only covers retries
+        # that land on the same one).  Trimmed with the placement table.
+        self._idem_index: dict[str, str] = {}  # ict: guarded-by(self._lock)
+        self._inflight = 0  # ict: guarded-by(self._lock)
+        # One shared full-jitter RNG for failover backoff; drawn under its
+        # own lock (random.Random is not documented thread-safe, and the
+        # ICT_BACKOFF_SEED test hook wants one reproducible stream).
+        self._rng_lock = threading.Lock()
+        self._backoff_rng = backoff.make_rng()  # ict: guarded-by(self._rng_lock)
+        self._stop_evt = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._server = None
+        self.port = cfg.port
+
+    # --- lifecycle ---
+
+    def start(self) -> None:
+        # Same contract as the daemon: telemetry="" must MEAN "honor
+        # ICT_TELEMETRY / disabled", never inherit a predecessor's sink.
+        events.configure(self.cfg.telemetry or None)
+        # Synchronous first poll: replica identities and load snapshots
+        # exist before the first placement decision.
+        self.registry.poll_once(self.client)
+        self._update_replica_gauges()
+        th = threading.Thread(target=self._poll_loop, daemon=True,
+                              name=f"ict-fleet-poll-{self.router_id}")
+        th.start()
+        self._threads.append(th)
+        self._server = ThreadingHTTPServer(
+            (self.cfg.host, self.cfg.port), _RouterHandler)
+        self._server.daemon_threads = True
+        self._server.router = self
+        self.port = self._server.server_address[1]
+        th = threading.Thread(target=self._server.serve_forever, daemon=True,
+                              name=f"ict-fleet-http-{self.router_id}")
+        th.start()
+        self._threads.append(th)
+        if not self.cfg.quiet:
+            alive = sum(1 for r in self.registry.snapshot() if r["alive"])
+            print(f"ict-fleet: router {self.router_id} listening on "
+                  f"http://{self.cfg.host}:{self.port} "
+                  f"({alive}/{len(self.cfg.replicas)} replicas alive)",
+                  file=sys.stderr)
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+        self._stop_evt.set()
+        with self._lock:
+            self._cond.notify_all()
+        for th in self._threads:
+            th.join(timeout=10)
+
+    # --- the poll loop: health, status refresh, failover, gauges ---
+
+    def _poll_loop(self) -> None:
+        while not self._stop_evt.wait(self.cfg.poll_interval_s):
+            self.poll_tick()
+
+    def poll_tick(self) -> None:
+        """One maintenance pass; public so tests (and the smoke check)
+        can drive the loop deterministically instead of sleeping."""
+        newly_dead = self.registry.poll_once(self.client)
+        for rep in newly_dead:
+            if not self.cfg.quiet:
+                print(f"ict-fleet: replica {rep.replica_id or rep.base_url} "
+                      f"is dead after {rep.consecutive_failures} failed "
+                      "health checks; re-routing its open placements",
+                      file=sys.stderr)
+        self._refresh_open_placements()
+        self._failover_sweep()
+        self._update_replica_gauges()
+        self._trim_placements()
+        # Replica capacity may have freed (placements turned terminal) —
+        # wake any submissions parked in the WFQ grant wait.
+        self._grant_free_slots()
+
+    def _refresh_open_placements(self) -> None:
+        with self._lock:
+            open_now = [p for p in self._placements.values()
+                        if p.state == "open"]
+        # One wedged replica must not stall every placement's refresh for
+        # a timeout each: after the first transport failure to a URL this
+        # tick, its remaining placements are skipped (the death countdown
+        # and the failover sweep own them from here).
+        unreachable_now: set[str] = set()
+        for p in open_now:
+            rep = self.registry.get(p.base_url)
+            if (rep is None or not rep.alive
+                    or p.base_url in unreachable_now):
+                continue   # the failover sweep owns unreachable replicas
+            try:
+                manifest = self.client.job(p.base_url, p.replica_job_id)
+            except ReplicaRefused as exc:
+                if exc.status != 404:
+                    continue
+                # A 404 right after accept is just spool-visibility lag —
+                # but a replica that KEEPS not knowing the job has lost it
+                # (restarted with a cleared spool inside the death
+                # window): fail the placement terminally instead of
+                # leaking its slot and quota forever.
+                with self._lock:
+                    p.missing_polls += 1
+                    gone = p.missing_polls >= MISSING_POLLS_LOST
+                if gone:
+                    self._mark_terminal(
+                        p, "error",
+                        error=f"job {p.replica_job_id} vanished from "
+                              f"replica {p.replica_id} (restarted with a "
+                              "cleared spool?)")
+                continue
+            except ReplicaUnreachable:
+                unreachable_now.add(p.base_url)
+                dead = self.registry.note_unreachable(p.base_url)
+                if dead is not None and not self.cfg.quiet:
+                    print(f"ict-fleet: replica {dead.replica_id} died "
+                          "mid-status-poll", file=sys.stderr)
+                continue
+            with self._lock:
+                p.missing_polls = 0
+            self._observe_manifest(p, manifest)
+
+    def _failover_sweep(self) -> None:
+        """Re-route every open placement whose replica is dead.  Runs on
+        the poll thread only; a sweep that cannot place (everyone busy)
+        leaves the placement open for the next tick — re-routing is
+        idempotent because the replica-side idempotency key rides inside
+        the stored payload."""
+        with self._lock:
+            stranded = [p for p in self._placements.values()
+                        if p.state == "open"]
+        for p in stranded:
+            rep = self.registry.get(p.base_url)
+            if rep is not None and rep.alive:
+                continue
+            from_id = p.replica_id or p.base_url
+            try:
+                new_rep, body = self._submit_with_failover(
+                    p.payload, p.trace_id, exclude={p.base_url})
+            except FleetBusy:
+                continue           # next tick retries
+            except ReplicaRefused as exc:
+                # A re-route the fleet *rejected* (e.g. the surviving
+                # replicas' --root refuses the path): the job can never
+                # complete — surface it as a terminal error instead of
+                # sweeping it forever.
+                self._mark_terminal(p, "error", error=str(exc))
+                continue
+            with self._lock:
+                p.base_url = new_rep.base_url
+                p.replica_id = new_rep.replica_id
+                p.replica_job_id = str(body.get("id", p.replica_job_id))
+                p.attempts += 1
+            self.metrics.count("fleet_failovers_total",
+                               {"from_replica": from_id})
+            if events.active():
+                events.emit("fleet_failover", trace_id=p.trace_id,
+                            job_id=p.job_id, from_replica=from_id,
+                            to_replica=new_rep.replica_id,
+                            tenant=p.tenant, attempts=p.attempts)
+            if not self.cfg.quiet:
+                print(f"ict-fleet: job {p.job_id} re-routed "
+                      f"{from_id} -> {new_rep.replica_id}", file=sys.stderr)
+
+    def _update_replica_gauges(self) -> None:
+        snap = self.registry.snapshot()
+        states = {"alive": 0, "draining": 0, "dead": 0}
+        depth: dict[tuple, float] = {}
+        buckets: dict[tuple, float] = {}
+        for row in snap:
+            rid = row["replica_id"] or row["base_url"]
+            if not row["alive"]:
+                states["dead"] += 1
+            elif row["draining"]:
+                states["draining"] += 1
+            else:
+                states["alive"] += 1
+            for queue in ("open_jobs", "load_queue_depth",
+                          "dispatch_queue_depth", "bucketed_cubes"):
+                depth[(("queue", queue), ("replica", rid))] = float(
+                    row.get(queue, 0) or 0)
+            for bucket, n in row["bucket_queue_depths"].items():
+                buckets[(("bucket", str(bucket)), ("replica", rid))] = float(n)
+        self.metrics.replace_gauge_family(
+            "fleet_replicas",
+            {(("state", s),): float(n) for s, n in states.items()})
+        self.metrics.replace_gauge_family("fleet_replica_queue_depth", depth)
+        self.metrics.replace_gauge_family(
+            "fleet_replica_bucket_queue_depth", buckets)
+        with self._lock:
+            open_n = sum(1 for p in self._placements.values()
+                         if p.state == "open")
+            queued = len(self._wfq)
+        self.metrics.replace_gauge_family(
+            "fleet_open_placements", {(): float(open_n)})
+        self.metrics.replace_gauge_family(
+            "fleet_queued_submissions", {(): float(queued)})
+
+    def _trim_placements(self) -> None:
+        """Bound the placement table by evicting the oldest TERMINAL
+        records beyond ``placement_keep`` (job ids are time-sortable, the
+        spool-trim rationale) — open placements are never touched."""
+        with self._lock:
+            terminal = sorted(jid for jid, p in self._placements.items()
+                              if p.state != "open")
+            for jid in terminal[: max(0, len(terminal)
+                                      - self.cfg.placement_keep)]:
+                del self._placements[jid]
+            # The idempotency index follows the placement table: an entry
+            # whose placement was trimmed can no longer dedupe (in-flight
+            # "" reservations are owned by their placing thread).
+            for key in [k for k, jid in self._idem_index.items()
+                        if jid and jid not in self._placements]:
+                del self._idem_index[key]
+
+    # --- placement ---
+
+    def place_job(self, payload: dict, tenant: str, trace_id: str) -> dict:
+        """Admit + grant + place one submission; returns the 202 body.
+        Raises QuotaExceeded (-> 429), FleetBusy (-> 503), ReplicaRefused
+        (the replica's own 4xx passes through)."""
+        key = str(payload.get("idempotency_key", "") or "")
+        known = self._resolve_idem(key)
+        if known is not None:
+            return known
+        try:
+            return self._place_fresh(payload, tenant, trace_id, key)
+        except BaseException:
+            self._drop_idem_reservation(key)
+            raise
+
+    def _resolve_idem(self, key: str) -> dict | None:
+        """Router-side idempotency: a key this router already placed
+        resolves to its existing fleet job (whatever replica serves it
+        now) instead of running again — the replica-side map only covers
+        retries that happen to land on the same replica.  Returns the
+        reply to serve, or None after reserving the key for a fresh
+        placement (the caller owns the reservation)."""
+        if not key:
+            return None
+        with self._lock:
+            known = self._idem_index.get(key)
+            if known is None:
+                self._idem_index[key] = ""   # reservation: we place it
+                return None
+        if known == "":
+            # Another handler thread is mid-placement on this key; a 503
+            # tells the client to retry into the resolved entry.
+            raise FleetBusy(f"a submission with idempotency key {key!r} "
+                            "is being placed; retry shortly")
+        code, manifest = self.job_manifest(known)
+        if code == 200:
+            self.metrics.count("fleet_deduped_submissions_total")
+            return {**manifest, "router_id": self.router_id}
+        # The placement was trimmed from the table: place afresh.
+        with self._lock:
+            self._idem_index[key] = ""
+        return None
+
+    def _drop_idem_reservation(self, key: str) -> None:
+        with self._lock:
+            if key and self._idem_index.get(key) == "":
+                del self._idem_index[key]
+
+    def _place_fresh(self, payload: dict, tenant: str, trace_id: str,
+                     key: str) -> dict:
+        try:
+            self.admission.admit(tenant)
+        except QuotaExceeded:
+            self.metrics.count("fleet_tenant_rejections_total",
+                               {"tenant": tenant})
+            raise
+        self.metrics.count("fleet_tenant_admissions_total",
+                           {"tenant": tenant})
+        try:
+            self._await_grant(tenant)
+        except BaseException:
+            self.admission.release(tenant)
+            raise
+        try:
+            rep, body = self._submit_with_failover(payload, trace_id)
+        except BaseException:
+            self._release_slot()
+            self.admission.release(tenant)
+            raise
+        placement = Placement(
+            job_id=str(body.get("id", "")),
+            tenant=tenant, trace_id=trace_id, payload=payload,
+            base_url=rep.base_url, replica_id=rep.replica_id,
+            replica_job_id=str(body.get("id", "")),
+            submitted_s=time.time())
+        with self._lock:
+            existing = self._placements.get(placement.job_id)
+            duplicate = existing is not None and existing.state == "open"
+            if not duplicate:
+                self._placements[placement.job_id] = placement
+            if key:
+                self._idem_index[key] = placement.job_id
+        if duplicate:
+            # The replica deduped a client-pinned idempotency key onto a
+            # job this router already tracks as OPEN: the original
+            # placement keeps the in-flight slot and the quota count, so
+            # the retry's admit/grant must be handed back here — silently
+            # replacing the record would leak one of each per retry.
+            self._release_slot()
+            self.admission.release(tenant)
+            return {**body, "tenant": tenant, "router_id": self.router_id}
+        self.metrics.count("fleet_placements_total",
+                           {"replica": rep.replica_id or rep.base_url})
+        if events.active():
+            events.emit("fleet_placement", trace_id=trace_id,
+                        job_id=placement.job_id,
+                        replica_id=rep.replica_id, tenant=tenant,
+                        bucket=self._bucket_of(payload))
+        return {**body, "tenant": tenant, "router_id": self.router_id}
+
+    def _await_grant(self, tenant: str) -> None:
+        """Weighted-fair wait for an in-flight slot.  With no budget
+        configured the grant is immediate; under contention, grants pop
+        in WFQ order as slots free (placements observed terminal)."""
+        ticket = _Ticket()
+        deadline = time.monotonic() + self.cfg.queue_timeout_s
+        with self._lock:
+            self._wfq.push(tenant, ticket)
+            self._grant_free_slots()
+            while not ticket.granted:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stop_evt.is_set():
+                    ticket.abandoned = True
+                    raise FleetBusy(
+                        f"no placement slot within "
+                        f"{self.cfg.queue_timeout_s:g}s "
+                        f"({self._inflight} in flight at the "
+                        f"--max_inflight budget); retry later")
+                self._cond.wait(remaining)
+
+    def _grant_free_slots(self) -> None:
+        """Pop WFQ tickets into free in-flight slots and wake their
+        waiters.  Takes the (reentrant) placement lock itself, so every
+        call site — callers already holding it included — keeps the
+        mutation lexically guarded."""
+        with self._lock:
+            while len(self._wfq) and (
+                    not self.cfg.max_inflight
+                    or self._inflight < self.cfg.max_inflight):
+                popped = self._wfq.pop()
+                if popped is None:
+                    break
+                _tenant, ticket = popped
+                if ticket.abandoned:
+                    continue
+                ticket.granted = True
+                self._inflight += 1
+            self._cond.notify_all()
+
+    def _release_slot(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+            self._grant_free_slots()
+
+    @staticmethod
+    def _bucket_of(payload: dict) -> str:
+        shape = payload.get("shape")
+        if (isinstance(shape, (list, tuple)) and len(shape) == 3
+                and all(isinstance(v, (int, float)) for v in shape)):
+            return bucket_label(shape)
+        return ""
+
+    def _ranked_candidates(self, bucket: str,
+                           exclude: set[str]) -> list[Replica]:
+        cands = [r for r in self.registry.candidates()
+                 if r.base_url not in exclude]
+
+        def score(rep: Replica) -> float:
+            s = rep.load()
+            if bucket:
+                if bucket in rep.warm_buckets():
+                    s -= AFFINITY_WARM
+                if rep.queued_buckets().get(bucket, 0) > 0:
+                    s -= AFFINITY_QUEUED
+            return s
+
+        # Deterministic tie-break on replica identity, so tests (and two
+        # routers sharing one fleet) rank identically from identical
+        # snapshots.
+        cands.sort(key=lambda r: (score(r), r.replica_id or r.base_url))
+        return cands
+
+    def _submit_with_failover(self, payload: dict, trace_id: str,
+                              exclude: set[str] | None = None):
+        """Walk the ranked candidates; on transport failure note the
+        death countdown and move on; on 503 (busy/draining) move on; on
+        any other refusal propagate (the client's problem, not the
+        fleet's).  Between sweeps, full-jitter backoff."""
+        exclude = set(exclude or ())
+        bucket = self._bucket_of(payload)
+        last_err: Exception | None = None
+        for sweep in range(1 + max(self.cfg.failover_retries, 0)):
+            if sweep:
+                with self._rng_lock:
+                    delay = backoff.full_jitter(
+                        self.cfg.retry_backoff_s, sweep - 1,
+                        rng=self._backoff_rng)
+                time.sleep(delay)
+            for rep in self._ranked_candidates(bucket, exclude):
+                try:
+                    body = self.client.submit(rep.base_url, payload,
+                                              trace_id=trace_id)
+                except ReplicaUnreachable as exc:
+                    last_err = exc
+                    self.registry.note_unreachable(rep.base_url)
+                    continue
+                except ReplicaRefused as exc:
+                    if exc.status == 503:   # at capacity, or draining
+                        last_err = exc
+                        continue
+                    raise
+                self.registry.note_placed(rep.base_url)
+                return rep, body
+        raise FleetBusy(f"no replica accepted the job: "
+                        f"{last_err or 'no live replicas'}")
+
+    # --- reads ---
+
+    def job_manifest(self, job_id: str) -> tuple[int, dict]:
+        with self._lock:
+            p = self._placements.get(job_id)
+        if p is None:
+            return 404, {"error": "no such job"}
+        rep = self.registry.get(p.base_url)
+        if p.state == "open" and (rep is None or rep.alive):
+            try:
+                manifest = self.client.job(p.base_url, p.replica_job_id)
+            except ReplicaRefused as exc:
+                return exc.status, exc.body
+            except ReplicaUnreachable:
+                self.registry.note_unreachable(p.base_url)
+                manifest = None
+            if manifest is not None:
+                self._observe_manifest(p, manifest)
+                return 200, {**manifest, "id": p.job_id,
+                             "replica_id": p.replica_id,
+                             "tenant": p.tenant}
+        if p.state == "open":
+            # The replica is unreachable and the failover sweep has not
+            # re-placed the job yet: report it still pending so clients
+            # keep polling through the hole.
+            return 200, {"id": p.job_id, "state": "pending",
+                         "replica_id": p.replica_id, "tenant": p.tenant,
+                         "trace_id": p.trace_id, "attempts": p.attempts,
+                         "detail": "replica unreachable; failover pending"}
+        # Terminal and remembered: serve the replica's full manifest when
+        # it is KNOWN reachable, the cached summary otherwise — a dead
+        # replica (it may stay dead for days) must not cost every read a
+        # connection timeout and a pinned handler thread.
+        if rep is not None and rep.alive:
+            try:
+                manifest = self.client.job(p.base_url, p.replica_job_id)
+                return 200, {**manifest, "id": p.job_id,
+                             "replica_id": p.replica_id, "tenant": p.tenant}
+            except ReplicaRefused:
+                pass
+            except ReplicaUnreachable:
+                self.registry.note_unreachable(p.base_url)
+        return 200, {"id": p.job_id, "state": p.state,
+                     "error": p.error or None,
+                     "replica_id": p.replica_id, "tenant": p.tenant,
+                     "trace_id": p.trace_id, "attempts": p.attempts}
+
+    def _observe_manifest(self, p: Placement, manifest: dict) -> None:
+        state = str(manifest.get("state", ""))
+        if state in ("done", "error"):
+            self._mark_terminal(p, state,
+                                error=str(manifest.get("error") or ""))
+
+    def _mark_terminal(self, p: Placement, state: str,
+                       error: str = "") -> None:
+        """Idempotent terminal transition: the quota and in-flight slot
+        are released exactly once however many readers observe it."""
+        with self._lock:
+            if p.state != "open":
+                return
+            p.state = state
+            p.error = error
+            self._inflight -= 1
+            self._grant_free_slots()
+        self.admission.release(p.tenant)
+        self.metrics.count("fleet_jobs_completed_total", {"state": state})
+
+    def health(self) -> dict:
+        snap = self.registry.snapshot()
+        with self._lock:
+            open_n = sum(1 for p in self._placements.values()
+                         if p.state == "open")
+            queued = len(self._wfq)
+            inflight = self._inflight
+        return {
+            "status": "ok",
+            "router_id": self.router_id,
+            "uptime_s": round(time.time() - self.started_s, 3),
+            "replicas": snap,
+            "replicas_alive": sum(1 for r in snap
+                                  if r["alive"] and not r["draining"]),
+            "open_placements": open_n,
+            "queued_submissions": queued,
+            "inflight": inflight,
+            "max_inflight": self.cfg.max_inflight,
+        }
+
+    def drain_replica(self, replica_id: str, flag: bool) -> tuple[int, dict]:
+        rep = self.registry.by_id(replica_id)
+        if rep is None:
+            return 404, {"error": f"no replica {replica_id!r} in the fleet"}
+        try:
+            body = self.client.drain(rep.base_url, flag)
+        except ReplicaRefused as exc:
+            return exc.status, exc.body
+        except ReplicaUnreachable as exc:
+            return 503, {"error": f"replica unreachable: {exc}"}
+        # Reflect the drain in the registry immediately — waiting for the
+        # next poll would leave a placement window on a draining replica.
+        self.registry.poll_once(self.client)
+        return 200, body
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    # Bound every socket read (the replica-API rule): a client that
+    # under-sends its declared body must time out, not pin this handler
+    # thread and its FD forever.
+    timeout = 30.0
+
+    def log_message(self, fmt, *args):  # noqa: A003 — stdlib signature
+        if not self.server.router.cfg.quiet:
+            BaseHTTPRequestHandler.log_message(self, fmt, *args)
+
+    def _reply(self, code: int, payload: dict,
+               headers: dict | None = None) -> None:
+        body = (json.dumps(payload) + "\n").encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if isinstance(payload, dict) and payload.get("trace_id"):
+            self.send_header("X-ICT-Trace", str(payload["trace_id"]))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+        except (TypeError, ValueError):
+            n = 0
+        return self.rfile.read(max(0, min(n, 1 << 20)))
+
+    def do_GET(self) -> None:  # noqa: N802 — stdlib signature
+        router = self.server.router
+        if self.path == "/healthz":
+            self._reply(200, router.health())
+        elif self.path == "/metrics":
+            body = router.metrics.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/replicas":
+            self._reply(200, {"replicas": router.registry.snapshot()})
+        elif self.path.startswith("/jobs/"):
+            jid = self.path[len("/jobs/"):]
+            code, payload = router.job_manifest(jid)
+            self._reply(code, payload)
+        else:
+            self._reply(404, {"error": f"no such route {self.path!r}"})
+
+    def do_POST(self) -> None:  # noqa: N802 — stdlib signature
+        router = self.server.router
+        if self.path == "/jobs":
+            self._post_job()
+            return
+        if (self.path.startswith("/replicas/")
+                and self.path.endswith("/drain")):
+            rid = self.path[len("/replicas/"): -len("/drain")]
+            try:
+                body = json.loads(self._read_body() or b"{}")
+                flag = bool(body.get("drain", True)) \
+                    if isinstance(body, dict) else True
+            except ValueError:
+                flag = True
+            code, payload = router.drain_replica(rid, flag)
+            self._reply(code, payload)
+            return
+        self._reply(404, {"error": f"no such route {self.path!r}"})
+
+    def _post_job(self) -> None:
+        router = self.server.router
+        try:
+            body = json.loads(self._read_body() or b"{}")
+            path = body["path"]
+            payload = {
+                "path": str(path),
+                "profile": bool(body.get("profile", False)),
+                "audit": bool(body.get("audit", False)),
+                # The client may pin its own idempotency key (its retry
+                # across routers then dedupes too); otherwise the router
+                # mints one — it is what makes failover re-routes safe.
+                "idempotency_key": str(body.get("idempotency_key", "")
+                                       or f"fleet-{uuid.uuid4().hex[:16]}"),
+            }
+            shape = body.get("shape")
+            if shape is not None:
+                payload["shape"] = [int(v) for v in shape]
+        except (ValueError, KeyError, TypeError) as exc:
+            self._reply(400, {"error": f"bad request body: {exc!r}; expected "
+                                       '{"path": "/abs/archive"}'})
+            return
+        tenant = str(self.headers.get("X-ICT-Tenant", "")
+                     or DEFAULT_TENANT)
+        trace_id = str(self.headers.get("X-ICT-Trace", "")
+                       or events.new_trace_id())
+        try:
+            reply = router.place_job(payload, tenant, trace_id)
+        except QuotaExceeded as exc:
+            self._reply(429, {"error": str(exc)},
+                        headers={"Retry-After": "5"})
+            return
+        except FleetBusy as exc:
+            self._reply(503, {"error": str(exc)},
+                        headers={"Retry-After": "5"})
+            return
+        except ReplicaRefused as exc:
+            self._reply(exc.status, exc.body)
+            return
+        except Exception as exc:  # noqa: BLE001 — the client deserves a 500
+            self._reply(500, {"error": f"placement failed: {exc}"})
+            return
+        self._reply(202, reply)
+
+
+# --- CLI ---
+
+def build_fleet_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ict-serve-fleet",
+        description="Fleet router: spreads jobs across N ict-serve "
+                    "replicas with shape-bucket affinity, drain/death "
+                    "failover, and multi-tenant admission "
+                    '(docs/SERVING.md "Fleet")')
+    p.add_argument("--replica", action="append", default=[], metavar="URL",
+                   help="replica base URL, e.g. http://host:8750 "
+                        "(repeatable; at least one unless --smoke)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8790,
+                   help="router HTTP port (0 = ephemeral; default 8790)")
+    p.add_argument("--router_id", default="", metavar="ID",
+                   help="stable router identity on /healthz and event-log "
+                        "lines (default: mint one per process life)")
+    p.add_argument("--poll_interval_s", type=float, default=1.0, metavar="S",
+                   help="health-poll / failover-sweep cadence (default 1.0)")
+    p.add_argument("--dead_after", type=int, default=3, metavar="N",
+                   help="consecutive unreachable health checks before a "
+                        "replica is dead and its open placements re-route "
+                        "(default 3)")
+    p.add_argument("--max_inflight", type=int, default=0, metavar="N",
+                   help="fleet-wide open-placement budget; submissions "
+                        "beyond it wait in weighted-fair order "
+                        "(0 = unbounded; default 0)")
+    p.add_argument("--queue_timeout_s", type=float, default=30.0, metavar="S",
+                   help="max wait for a placement slot before 503 "
+                        "(default 30)")
+    p.add_argument("--failover_retries", type=int, default=2, metavar="N",
+                   help="extra full-jitter candidate sweeps per submission "
+                        "(default 2)")
+    p.add_argument("--retry_backoff_s", type=float, default=0.25, metavar="S",
+                   help="full-jitter backoff base between sweeps "
+                        "(default 0.25)")
+    p.add_argument("--tenant", action="append", default=[],
+                   metavar="NAME:QUOTA:WEIGHT",
+                   help="per-tenant admission spec (repeatable): QUOTA open "
+                        "placements (0 = unbounded) and WFQ WEIGHT, e.g. "
+                        "--tenant survey:64:3 --tenant adhoc:8:1")
+    p.add_argument("--default_quota", type=int, default=0, metavar="N",
+                   help="open-placement quota for undeclared tenants "
+                        "(0 = unbounded; default 0)")
+    p.add_argument("--default_weight", type=float, default=1.0, metavar="W",
+                   help="WFQ weight for undeclared tenants (default 1.0)")
+    p.add_argument("--telemetry", default="", metavar="PATH",
+                   help="append fleet_placement/fleet_failover events to "
+                        "PATH as JSON lines (ICT_TELEMETRY equivalent)")
+    p.add_argument("-q", "--quiet", action="store_true")
+    p.add_argument("--smoke", action="store_true",
+                   help="offline self-check: 2 in-process replicas behind "
+                        "the router, jobs submitted through it, one replica "
+                        "killed mid-queue, every job must complete exactly "
+                        "once with oracle-identical masks; one JSON line")
+    return p
+
+
+def parse_tenant_specs(specs: list[str]) -> tuple[dict, dict]:
+    quotas: dict[str, int] = {}
+    weights: dict[str, float] = {}
+    for spec in specs:
+        try:
+            name, quota, weight = spec.split(":")
+            if not name:
+                raise ValueError
+            quotas[name] = int(quota)
+            weights[name] = float(weight)
+            if quotas[name] < 0 or weights[name] <= 0:
+                raise ValueError
+        except ValueError:
+            raise ValueError(
+                f"bad --tenant spec {spec!r}; expected NAME:QUOTA:WEIGHT "
+                "like survey:64:3 (quota >= 0, weight > 0)") from None
+    return quotas, weights
+
+
+def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
+    if not args.replica and not args.smoke:
+        raise ValueError("at least one --replica URL is required "
+                         "(or --smoke for the self-check)")
+    if args.dead_after < 1:
+        raise ValueError(f"--dead_after must be >= 1, got {args.dead_after}")
+    if args.max_inflight < 0:
+        raise ValueError(f"--max_inflight must be >= 0 (0 = unbounded), "
+                         f"got {args.max_inflight}")
+    quotas, weights = parse_tenant_specs(args.tenant)
+    return FleetConfig(
+        replicas=tuple(args.replica),
+        host=args.host,
+        port=args.port,
+        router_id=args.router_id,
+        poll_interval_s=args.poll_interval_s,
+        dead_after=args.dead_after,
+        max_inflight=args.max_inflight,
+        queue_timeout_s=args.queue_timeout_s,
+        failover_retries=args.failover_retries,
+        retry_backoff_s=args.retry_backoff_s,
+        tenant_quotas=quotas,
+        tenant_weights=weights,
+        default_quota=args.default_quota,
+        default_weight=args.default_weight,
+        telemetry=args.telemetry,
+        quiet=args.quiet,
+    )
+
+
+def run_fleet_smoke(cfg: FleetConfig) -> int:
+    """Offline fleet self-check: 2 in-process replicas behind one router;
+    several jobs submitted THROUGH the router; the replica holding a
+    parked (undispatched) job is killed; every job must complete exactly
+    once with masks bit-identical to the numpy oracle and the shadow
+    audit clean; at least one failover must be recorded.  One JSON line,
+    rc 0/1 — the CI lane next to ``serve --smoke``."""
+    import os
+    import tempfile
+    import urllib.request
+
+    import numpy as np
+
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.core.cleaner import clean_cube
+    from iterative_cleaner_tpu.io.npz import NpzIO
+    from iterative_cleaner_tpu.io.synthetic import make_archive
+    from iterative_cleaner_tpu.obs import tracing
+    from iterative_cleaner_tpu.ops.preprocess import preprocess
+    from iterative_cleaner_tpu.parallel.batch import finalize_weights
+    from iterative_cleaner_tpu.service.daemon import CleaningService
+    from iterative_cleaner_tpu.service.daemon import ServeConfig
+    from iterative_cleaner_tpu.service.jobs import TERMINAL
+
+    def serve_cfg(tag: str, tmp: str, deadline_s: float,
+                  bucket_cap: int = 0) -> ServeConfig:
+        return ServeConfig(
+            spool_dir=os.path.join(tmp, f"spool_{tag}"), port=0,
+            replica_id=f"smoke-{tag}", deadline_s=deadline_s,
+            bucket_cap=bucket_cap,
+            quiet=True, clean=CleanConfig(backend="jax", quiet=True))
+
+    result = {"smoke": "FAIL"}
+    with tempfile.TemporaryDirectory(prefix="ict_fleet_smoke_") as tmp:
+        paths = []
+        for i in range(3):
+            p = os.path.join(tmp, f"smoke{i}.npz")
+            NpzIO().save(make_archive(nsub=4, nchan=16, nbin=64,
+                                      seed=200 + i), p)
+            paths.append(p)
+        # Replica a parks decoded cubes (huge deadline + a wide explicit
+        # bucket that never fills): the job placed on it is accepted-but-
+        # undispatched when it dies — exactly the failover case the
+        # router must cover.  Replica b drains fast.
+        svc_a = CleaningService(serve_cfg("a", tmp, deadline_s=3600.0,
+                                          bucket_cap=8))
+        svc_b = CleaningService(serve_cfg("b", tmp, deadline_s=0.2))
+        svc_a.start()
+        svc_b.start()
+        # Hermetic overrides only (the run_smoke idiom): replicas and the
+        # port are the smoke's own; every other operator flag
+        # (--dead_after, --poll_interval_s, tenant specs, --telemetry, -q)
+        # is honored so the smoke exercises the configured behavior —
+        # with a faster-than-default poll/death cadence when the operator
+        # left them at the defaults, to keep the CI lane snappy.
+        poll_s = (0.2 if cfg.poll_interval_s == FleetConfig.poll_interval_s
+                  else cfg.poll_interval_s)
+        dead_after = (2 if cfg.dead_after == FleetConfig.dead_after
+                      else cfg.dead_after)
+        router = FleetRouter(FleetConfig(**{
+            **cfg.__dict__,
+            "replicas": (f"http://127.0.0.1:{svc_a.port}",
+                         f"http://127.0.0.1:{svc_b.port}"),
+            "port": 0,
+            "poll_interval_s": poll_s,
+            "dead_after": dead_after,
+        }))
+        router.start()
+        jobs = {}
+        try:
+            base = f"http://{router.cfg.host}:{router.port}"
+            before_done = tracing.counters_snapshot().get(
+                "service_jobs_done", 0)
+            for p in paths:
+                req = urllib.request.Request(
+                    f"{base}/jobs",
+                    data=json.dumps({"path": p, "audit": True,
+                                     "shape": [4, 16, 64]}).encode(),
+                    headers={"Content-Type": "application/json"})
+                jobs[p] = json.load(urllib.request.urlopen(req, timeout=30))
+            placed_on_a = [j for j in jobs.values()
+                           if j.get("replica_id") == "smoke-a"]
+            # Wait until replica a has actually decoded and PARKED its
+            # job(s) (bucketed, not yet dispatched), then kill it.
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                health = json.load(urllib.request.urlopen(
+                    f"http://127.0.0.1:{svc_a.port}/healthz", timeout=10))
+                if health.get("bucketed_cubes", 0) >= len(placed_on_a) > 0:
+                    break
+                time.sleep(0.05)
+            svc_a.stop()    # the "crash": parked jobs stay in its spool
+            # Router polls mark a dead and re-route; wait for every job
+            # (under its fleet id) to turn terminal through the router.
+            deadline = time.time() + 300
+            states = {}
+            while time.time() < deadline:
+                states = {p: json.load(urllib.request.urlopen(
+                    f"{base}/jobs/{j['id']}", timeout=10))
+                    for p, j in jobs.items()}
+                if all(s.get("state") in TERMINAL for s in states.values()):
+                    break
+                time.sleep(0.1)
+            all_done = all(s.get("state") == "done"
+                           for s in states.values())
+            # Exactly once: the fleet-wide completion count (both
+            # replicas share this process's tracing registry) moved by
+            # exactly len(paths).
+            done_delta = tracing.counters_snapshot().get(
+                "service_jobs_done", 0) - before_done
+            svc_b.auditor.drain(60)
+            health_b = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{svc_b.port}/healthz", timeout=10))
+            masks_ok = all_done
+            if all_done:
+                cfg_np = CleanConfig(backend="numpy")
+                for p in paths:
+                    want, _rfi = finalize_weights(
+                        clean_cube(*preprocess(NpzIO().load(p)),
+                                   cfg_np).weights, cfg_np)
+                    got = NpzIO().load(states[p]["out_path"])
+                    if not np.array_equal(got.weights, want):
+                        masks_ok = False
+            failovers = router.metrics.counter_total("fleet_failovers_total")
+            ok = (all_done and masks_ok and failovers >= 1
+                  and done_delta == len(paths)
+                  and health_b.get("audits_run", 0) >= 1
+                  and health_b.get("audit_divergences", 0) == 0)
+            result = {
+                "smoke": "ok" if ok else "FAIL",
+                "jobs": len(paths),
+                "jobs_done": sum(1 for s in states.values()
+                                 if s.get("state") == "done"),
+                "completions": int(done_delta),
+                "failovers": int(failovers),
+                "mask_identical_to_oracle": bool(masks_ok),
+                "audits_run": health_b.get("audits_run", 0),
+                "audit_divergences": health_b.get("audit_divergences", 0),
+                "placements": {
+                    rid: int(router.metrics.counter_value(
+                        "fleet_placements_total", {"replica": rid}))
+                    for rid in ("smoke-a", "smoke-b")},
+            }
+            return 0 if ok else 1
+        finally:
+            print(json.dumps(result))
+            router.stop()
+            svc_b.stop()
+
+
+def fleet_main(argv: list[str] | None = None) -> int:
+    args = build_fleet_parser().parse_args(argv)
+    try:
+        cfg = fleet_config_from_args(args)
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    if args.smoke:
+        return run_fleet_smoke(cfg)
+    try:
+        router = FleetRouter(cfg)
+        router.start()
+    except (RuntimeError, OSError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    import signal
+
+    def _on_stop_signal(signum, frame):
+        name = signal.Signals(signum).name
+        print(f"ict-fleet: {name} — shutting down (replicas keep their "
+              "accepted work; placements resume on restart via replica "
+              "spools)", file=sys.stderr)
+        raise SystemExit(0)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _on_stop_signal)
+        except (ValueError, OSError):  # noqa: PERF203 — non-main-thread embed
+            pass
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.stop()
+    return 0
+
+
+def console_main() -> int:
+    return fleet_main(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    raise SystemExit(fleet_main())

@@ -5,6 +5,12 @@ one-shot — load, clean, exit — paying cold compiles and device setup per
 invocation.  Real RFI-mitigation deployments are continuous pipelines
 (cf. arXiv:1701.08197), so this subsystem keeps one process alive:
 
+- :mod:`.context`   — ReplicaContext: one replica's identity + shared
+                      mutable state (job index, idempotency map, demotion
+                      machine, drain flag) — scheduler/worker/pool are
+                      constructed from it alone, so fleet tests stand up
+                      3+ replicas in one process (fleet/ routes across
+                      them)
 - :mod:`.jobs`      — job records + on-disk spool (restart-safe manifest)
 - :mod:`.scheduler` — shape-bucketed admission queue (dp-slice / deadline)
 - :mod:`.worker`    — fault-isolated dispatch (retry, oracle fallback)
@@ -23,6 +29,8 @@ tests/test_parallel.py; the degraded route IS the oracle).
 """
 
 from iterative_cleaner_tpu.service.jobs import Job, JobSpool
+from iterative_cleaner_tpu.service.context import ReplicaContext, ServiceBusy
 from iterative_cleaner_tpu.service.daemon import CleaningService, ServeConfig
 
-__all__ = ["Job", "JobSpool", "CleaningService", "ServeConfig"]
+__all__ = ["Job", "JobSpool", "CleaningService", "ServeConfig",
+           "ReplicaContext", "ServiceBusy"]

@@ -5,7 +5,20 @@ docs/OBSERVABILITY.md):
 
 - ``POST /jobs``            ``{"path": "/abs/archive.npz"}`` -> 202 + job
                             (the response and its ``X-ICT-Trace`` header
-                            carry the job's telemetry ``trace_id``)
+                            carry the job's telemetry ``trace_id``; an
+                            inbound ``X-ICT-Trace`` — the fleet router's
+                            proxied hop — is adopted instead of minting;
+                            the 202 body carries ``replica_id`` so trace
+                            logs attribute jobs to replicas; an optional
+                            ``"idempotency_key"`` dedupes re-submissions —
+                            the router's failover path)
+- ``POST /drain``           enter/leave drain mode (body optional
+                            ``{"drain": false}`` to undrain): a draining
+                            replica 503s new submissions, reports
+                            ``draining: true`` on ``/healthz`` (the fleet
+                            router stops placing on it), and flushes
+                            parked partial buckets so accepted work
+                            finishes fast
 - ``GET  /jobs/<id>``       job manifest (state machine in service/jobs.py)
 - ``GET  /jobs/<id>/trace`` convergence forensics: trace id, termination
                             reason, per-iteration timeline
@@ -202,6 +215,9 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/debug/profile":
             self._post_debug_profile()
             return
+        if self.path == "/drain":
+            self._post_drain()
+            return
         if self.path.startswith("/sessions/"):
             rest = self.path[len("/sessions/"):]
             sid, sep, verb = rest.partition("/")
@@ -252,6 +268,23 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._reply(200, rec)
 
+    # --- drain mode (the fleet router's /healthz-driven eviction hook) ---
+
+    def _post_drain(self) -> None:
+        service = self.server.service
+        try:
+            body = json.loads(self._read_body(1 << 20) or b"{}")
+            if not isinstance(body, dict):
+                raise TypeError("body must be a JSON object")
+            flag = bool(body.get("drain", True))
+        except (ValueError, TypeError) as exc:
+            self._reply(400, {"error": f"bad drain request: {exc!r}; "
+                                       'expected {} or {"drain": false}'})
+            return
+        service.set_draining(flag)
+        self._reply(200, {"replica_id": service.replica_id,
+                          "draining": flag})
+
     # --- jobs ---
 
     def _post_job(self) -> None:
@@ -261,6 +294,7 @@ class _Handler(BaseHTTPRequestHandler):
             path = body["path"]
             profile = bool(body.get("profile", False))
             audit = bool(body.get("audit", False))
+            idem_key = str(body.get("idempotency_key", "") or "")
         # TypeError covers valid-JSON non-dict bodies ('[]', '5', 'null'):
         # the client gets a 400, not a dropped socket.
         except (ValueError, KeyError, TypeError) as exc:
@@ -269,8 +303,15 @@ class _Handler(BaseHTTPRequestHandler):
             return
         from iterative_cleaner_tpu.service.daemon import ServiceBusy
 
+        # A submission that already crossed the fleet router carries its
+        # trace context in the X-ICT-Trace header; adopt it instead of
+        # minting so the event log threads router placement -> replica
+        # dispatch under ONE trace_id.
+        trace_id = str(self.headers.get("X-ICT-Trace", "") or "")
         try:
-            job = service.submit(str(path), profile=profile, audit=audit)
+            job = service.submit(str(path), profile=profile, audit=audit,
+                                 idempotency_key=idem_key,
+                                 trace_id=trace_id)
         except ServiceBusy as exc:
             self._reply(503, {"error": str(exc)}, headers={"Retry-After": "5"})
             return
@@ -281,7 +322,9 @@ class _Handler(BaseHTTPRequestHandler):
             # the client deserves a 500, not a dropped socket
             self._reply(500, {"error": f"submission failed: {exc}"})
             return
-        self._reply(202, job.to_dict())
+        # replica_id rides on every 202 so multi-replica trace logs (and
+        # the fleet router's placement table) attribute jobs to replicas.
+        self._reply(202, {**job.to_dict(), "replica_id": service.replica_id})
 
     # --- streaming sessions ---
 

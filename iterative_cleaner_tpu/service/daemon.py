@@ -36,18 +36,15 @@ from iterative_cleaner_tpu.obs import (
     memory as obs_memory,
     tracing,
 )
-from iterative_cleaner_tpu.service.jobs import TERMINAL, Job, JobSpool
+from iterative_cleaner_tpu.service.context import (  # noqa: F401 — ServiceBusy
+    ReplicaContext,                  # re-exported for compatibility: the
+    ServiceBusy,                     # API layer and embedders import it here
+)
+from iterative_cleaner_tpu.service.jobs import TERMINAL, Job
 from iterative_cleaner_tpu.service.scheduler import ShapeBucketScheduler
 from iterative_cleaner_tpu.service.worker import DispatchWorker
 
 _STOP = object()
-
-
-class ServiceBusy(RuntimeError):
-    """Admission refused: the open-job cap is reached (the API maps this to
-    503 + Retry-After).  The cap is the daemon's backpressure — every open
-    job can hold one decoded f32 cube on host, so unbounded admission would
-    let a submission burst outrun the single dispatch thread and OOM."""
 
 
 @dataclass
@@ -55,6 +52,9 @@ class ServeConfig:
     spool_dir: str = "./ict_serve_spool"
     host: str = "127.0.0.1"
     port: int = 8750                 # 0 = ephemeral (tests)
+    replica_id: str = ""             # fleet identity on /healthz and every
+                                     # 202 (docs/SERVING.md "Fleet");
+                                     # "" = mint one per process life
     bucket_cap: int = 0              # 0 = the mesh's dp extent
     deadline_s: float = 2.0          # max wait before a partial bucket flushes
     loaders: int = 2
@@ -89,41 +89,65 @@ class CleaningService:
     def __init__(self, serve_cfg: ServeConfig, mesh=None) -> None:
         self.serve_cfg = serve_cfg
         self.clean_cfg = serve_cfg.clean
-        self.spool = JobSpool(serve_cfg.spool_dir)
-        self.mesh = mesh
+        # ALL per-replica mutable state (job index, idempotency map,
+        # demotion machine, drain flag) lives on the explicit context —
+        # the scheduler/worker/pool are constructed from it alone, so N
+        # replicas coexist in one process (service/context.py).  This
+        # object keeps only lifecycle: threads, the HTTP server, wiring.
+        self.ctx = ReplicaContext(serve_cfg, mesh=mesh)
         self.started_s = time.time()   # re-stamped at start(); /healthz uptime
-        # Demotion state ("jax" | "numpy") is written by three threads
-        # (startup, the dispatch worker's note_dispatch_failure, the shadow
-        # auditor's note_audit_divergence) and read everywhere: one lock
-        # makes the count-then-demote transition atomic, so two racing
-        # failure reports can neither lose an increment nor double-fire
-        # the demotion side effects (flight dump, stderr line).
-        self._mode_lock = threading.Lock()
-        self.backend_mode = self.clean_cfg.backend  # ict: guarded-by(self._mode_lock)
         self.bucket_cap = 1
         self.port = serve_cfg.port
         self.pool = None
-        self._jobs: dict[str, Job] = {}  # ict: guarded-by(self._jobs_lock)
-        self._jobs_lock = threading.Lock()
         self._load_q: queue.Queue = queue.Queue()
-        self._consecutive_failures = 0  # ict: guarded-by(self._mode_lock)
         self._threads: list[threading.Thread] = []
         self._stop_evt = threading.Event()
         self._server = None
         self.scheduler = None
         self.worker = None
         self.sessions = None
-        # Device-level observability artifacts live under the spool (the
-        # single-daemon flock already covers it): profiler captures
-        # (obs/profiling — POST /debug/profile, per-job capture) and
-        # flight-recorder dumps (obs/flight — fault-ladder trips, SIGTERM).
-        self.profile_root = os.path.join(serve_cfg.spool_dir, "profiles")
-        self.flight_dir = os.path.join(serve_cfg.spool_dir, "flight")
-        # Divergence repro bundles (obs/audit): the shadow auditor writes
-        # one self-contained directory per confirmed mask mismatch here.
-        self.repro_dir = os.path.join(serve_cfg.spool_dir, "repro")
-        self.auditor = None
-        self._audit_divergences = 0  # ict: guarded-by(self._mode_lock)
+
+    # Compatibility views onto the context (tests and embedders predate
+    # the ReplicaContext split; the context is the single owner).
+    @property
+    def spool(self):
+        return self.ctx.spool
+
+    @property
+    def mesh(self):
+        return self.ctx.mesh
+
+    @property
+    def replica_id(self) -> str:
+        return self.ctx.replica_id
+
+    @property
+    def backend_mode(self) -> str:
+        return self.ctx.backend_mode
+
+    @property
+    def auditor(self):
+        return self.ctx.auditor
+
+    @property
+    def profile_root(self) -> str:
+        return self.ctx.profile_root
+
+    @property
+    def flight_dir(self) -> str:
+        return self.ctx.flight_dir
+
+    @property
+    def repro_dir(self) -> str:
+        return self.ctx.repro_dir
+
+    @property
+    def _jobs(self):
+        return self.ctx._jobs
+
+    @property
+    def _jobs_lock(self):
+        return self.ctx._jobs_lock
 
     # --- lifecycle ---
 
@@ -155,7 +179,8 @@ class CleaningService:
         # log file.
         events.configure(self.serve_cfg.telemetry or None)
         flight.note("daemon_starting", spool=self.spool.root,
-                    backend=self.backend_mode)
+                    backend=self.backend_mode,
+                    replica_id=self.replica_id)
         if self.backend_mode == "jax":
             # Compile accounting on /metrics (compiles, compile seconds per
             # shape bucket, persistent-cache events).  JAX path only: the
@@ -174,19 +199,23 @@ class CleaningService:
                 print("ict-serve: backend liveness indeterminable after a "
                       "hung probe; serving via the numpy oracle",
                       file=sys.stderr)
-                with self._mode_lock:
-                    self.backend_mode = "numpy"
-        cap = 1
+                self.ctx.demote_for_liveness()
+        # An explicit --bucket_cap is honored on EVERY backend (a numpy
+        # replica in a fleet test can park cubes in a wide bucket); the
+        # default stays backend-dependent: the mesh's dp extent for jax,
+        # 1 for the oracle.
+        cap = self.serve_cfg.bucket_cap or 1
         if self.backend_mode == "jax":
-            if self.mesh is None:
+            if self.ctx.mesh is None:
                 from iterative_cleaner_tpu.parallel.mesh import make_mesh
 
                 # make_mesh is this daemon's first in-process device read;
                 # its internal init_watchdog turns a wedged-tunnel freeze
                 # into a structured warning (ICT_INIT_TIMEOUT_S) instead
                 # of a silent never-came-up.
-                self.mesh = make_mesh()
-            cap = self.serve_cfg.bucket_cap or max(int(self.mesh.shape["dp"]), 1)
+                self.ctx.mesh = make_mesh()
+            cap = self.serve_cfg.bucket_cap or max(
+                int(self.ctx.mesh.shape["dp"]), 1)
         self.scheduler = ShapeBucketScheduler(
             cap, self.serve_cfg.deadline_s, self._on_flush)
         # The pow2 clamp lives in the scheduler (the mechanism that owns
@@ -196,8 +225,7 @@ class CleaningService:
         if self.backend_mode == "jax":
             from iterative_cleaner_tpu.service.pool import WarmPool
 
-            self.pool = WarmPool(self.clean_cfg, self.mesh, self.bucket_cap,
-                                 quiet=self.serve_cfg.quiet)
+            self.pool = WarmPool(self.ctx, self.bucket_cap)
             self.pool.warm_startup(self.serve_cfg.warm_shapes)
         from iterative_cleaner_tpu.service.sessions import SessionManager
 
@@ -214,7 +242,7 @@ class CleaningService:
             quiet=self.serve_cfg.quiet,
             cfg_provider=lambda: self.clean_cfg.replace(
                 backend=self.backend_mode))
-        self.worker = DispatchWorker(self)
+        self.worker = DispatchWorker(self.ctx)
         # Spool trim + replay run BEFORE any thread starts: the trim's
         # .json.part sweep is only safe while no writer thread exists (the
         # invariant jobs.trim documents), and the worker object's _fail
@@ -224,11 +252,16 @@ class CleaningService:
         # drain them once started below.
         spooled = self.spool.all_jobs()
         self.spool.trim(self.serve_cfg.spool_keep, jobs=spooled)
+        # The idempotency map is rebuilt over EVERY manifest, terminal
+        # included: a router failover retry of a job that in fact
+        # finished before the restart must dedupe to the finished
+        # manifest, never trigger a second run.
+        for job in spooled:
+            self.ctx.remember_idem(job)
         # Recovered jobs keep their original (older, time-sortable) ids,
         # so they drain ahead of new traffic of the same shape.
         for job in self.spool.recover(jobs=spooled):
-            with self._jobs_lock:
-                self._jobs[job.id] = job
+            self.ctx.index(job)
             try:
                 # Replayed manifests are re-validated against the CURRENT
                 # --root (the boundary may have changed across restarts,
@@ -247,12 +280,12 @@ class CleaningService:
         # documents).
         from iterative_cleaner_tpu.obs.audit import ShadowAuditor
 
-        self.auditor = ShadowAuditor(
+        self.ctx.auditor = ShadowAuditor(
             self.spool, self.repro_dir,
-            on_divergence=self.note_audit_divergence,
+            on_divergence=self.ctx.note_audit_divergence,
             quiet=self.serve_cfg.quiet)
-        self.auditor.start()
-        self._threads.append(self.auditor)
+        self.ctx.auditor.start()
+        self._threads.append(self.ctx.auditor)
         self.worker.start()
         self._threads.append(self.worker)
         for i in range(max(self.serve_cfg.loaders, 1)):
@@ -274,7 +307,7 @@ class CleaningService:
         th.start()
         self._threads.append(th)
         if not self.serve_cfg.quiet:
-            print(f"ict-serve: listening on "
+            print(f"ict-serve: replica {self.replica_id} listening on "
                   f"http://{self.serve_cfg.host}:{self.port} "
                   f"(backend={self.backend_mode}, bucket_cap="
                   f"{self.bucket_cap}, spool={self.spool.root})",
@@ -313,53 +346,65 @@ class CleaningService:
     # --- submission / inspection (the API's surface) ---
 
     def submit(self, path: str, profile: bool = False,
-               audit: bool = False) -> Job:
+               audit: bool = False, idempotency_key: str = "",
+               trace_id: str = "") -> Job:
+        # A draining replica accepts no NEW work (503; the router reads the
+        # same flag off /healthz and stops placing here) — already-accepted
+        # jobs keep running to completion (docs/SERVING.md "Fleet").
+        if self.ctx.is_draining():
+            tracing.count("service_jobs_refused")
+            raise ServiceBusy(
+                f"replica {self.replica_id} is draining; no new admissions")
         path = self._check_root(path)
-        from iterative_cleaner_tpu.service.jobs import new_job_id
-
-        # The trace context is minted HERE, at the entry point, and rides
-        # on the job through every layer (admission, dispatch, iteration
-        # events) — echoed in the 202 response and the X-ICT-Trace header.
+        # Idempotent re-submission (the router's failover path): the same
+        # key returns the already-admitted job — open OR terminal (the
+        # spool manifest outlives retire()) — instead of running it twice.
+        if idempotency_key:
+            prior = self.ctx.idem_job_id(idempotency_key)
+            if prior is not None:
+                known = self.job(prior)
+                if known is not None:
+                    tracing.count("service_jobs_deduped")
+                    return known
+        # The trace context is minted at the entry point unless the
+        # submitter carried one across the router hop (X-ICT-Trace); it
+        # rides on the job through every layer (admission, dispatch,
+        # iteration events) — echoed in the 202 response and header.
         # ``profile`` asks for a jax.profiler capture around this job's
         # dispatch (obs/profiling); the artifact dir lands on the manifest.
         # ``audit`` asks for a shadow-oracle parity replay after it serves
         # (obs/audit; ICT_AUDIT_RATE / --audit_rate samples the rest).
-        job = Job(id=new_job_id(), path=path, submitted_s=time.time(),
-                  trace_id=events.new_trace_id(), profile=bool(profile),
-                  audit=bool(audit))
-        # Cap check and insert under ONE lock hold: concurrent POST handler
-        # threads must not all pass the check before any of them inserts
-        # (the cap is the OOM backpressure — a race would breach it).
-        with self._jobs_lock:
-            if self.serve_cfg.max_open_jobs:
-                # retire() evicts terminal jobs, so this scan is O(open).
-                open_n = sum(1 for j in self._jobs.values()
-                             if j.state not in TERMINAL)
-                if open_n >= self.serve_cfg.max_open_jobs:
-                    tracing.count("service_jobs_refused")
-                    raise ServiceBusy(
-                        f"{open_n} open jobs at the --max_open_jobs cap "
-                        f"({self.serve_cfg.max_open_jobs}); retry later")
-            self._jobs[job.id] = job
+        job = self.ctx.new_job(path, profile=profile, audit=audit,
+                               idempotency_key=idempotency_key,
+                               trace_id=trace_id)
+        dup_id = self.ctx.admit(job, idempotency_key)
+        if dup_id is not None:
+            # Lost an admission race on the same key: serve the winner.
+            known = self.job(dup_id)
+            if known is not None:
+                tracing.count("service_jobs_deduped")
+                return known
+            raise ValueError(
+                f"idempotency key {idempotency_key!r} maps to a pruned "
+                "job manifest; resubmit with a fresh key")
         try:
             self.spool.save(job)
         except Exception:
             # Roll the admission back: a job that was never made durable is
-            # also never enqueued, so leaving it in _jobs would leak one
+            # also never enqueued, so leaving it indexed would leak one
             # max_open_jobs slot per failed save until restart.
-            with self._jobs_lock:
-                self._jobs.pop(job.id, None)
+            self.ctx.rollback(job, idempotency_key)
             raise
         tracing.count("service_jobs_submitted")
         if events.active():
             events.emit("job_submitted", trace_id=job.trace_id,
-                        job_id=job.id, path=path)
+                        job_id=job.id, path=path,
+                        replica_id=self.replica_id)
         self._load_q.put(job)
         return job
 
     def job(self, job_id: str) -> Job | None:
-        with self._jobs_lock:
-            job = self._jobs.get(job_id)
+        job = self.ctx.lookup(job_id)
         return job if job is not None else self.spool.get(job_id)
 
     def _check_root(self, path: str) -> str:
@@ -388,17 +433,24 @@ class CleaningService:
         is the durable record (job() falls back to it), so a continuous-
         traffic daemon's memory stays bounded by OPEN work, not by every
         job it ever served."""
-        with self._jobs_lock:
-            self._jobs.pop(job.id, None)
+        self.ctx.retire(job)
 
     def audit_rate(self) -> float:
         """The effective shadow-audit sampling fraction: an explicit
         --audit_rate wins; < 0 honors ICT_AUDIT_RATE (default 0)."""
-        from iterative_cleaner_tpu.obs import audit as obs_audit
+        return self.ctx.audit_rate()
 
-        if self.serve_cfg.audit_rate >= 0:
-            return min(self.serve_cfg.audit_rate, 1.0)
-        return obs_audit.audit_rate()
+    def set_draining(self, flag: bool = True) -> None:
+        """Enter (or leave) drain mode: /healthz flips ``draining``, new
+        submissions get 503, and parked partial buckets flush immediately
+        so accepted work finishes as fast as it can — the fleet router
+        reads the flag and stops placing here (docs/SERVING.md)."""
+        self.ctx.set_draining(flag)
+        if flag and self.scheduler is not None:
+            self.scheduler.flush_all()
+        if events.active():
+            events.emit("replica_draining" if flag else "replica_undraining",
+                        replica_id=self.replica_id)
 
     def health(self) -> dict:
         """Liveness + the drain signals a load balancer needs: uptime,
@@ -409,12 +461,12 @@ class CleaningService:
         from iterative_cleaner_tpu import __version__
         from iterative_cleaner_tpu.obs import audit as obs_audit
 
-        with self._jobs_lock:
-            open_jobs = sum(1 for j in self._jobs.values()
-                            if j.state not in TERMINAL)
+        open_jobs = self.ctx.open_count()
         audit_rep = obs_audit.audit_report()
         return {
             "status": "ok",
+            "replica_id": self.replica_id,
+            "draining": self.ctx.is_draining(),
             "backend": self.backend_mode,
             "version": __version__,
             "uptime_s": round(time.time() - self.started_s, 3),
@@ -424,6 +476,11 @@ class CleaningService:
                                      if self.worker else 0),
             "bucketed_cubes": (self.scheduler.pending_count()
                                if self.scheduler else 0),
+            # Bucket-RESOLVED queue depths (NSUBxNCHANxNBIN -> cubes):
+            # the fleet router's affinity-placement signal — aggregate
+            # depths cannot tell it which replica is working a shape.
+            "bucket_queue_depths": (self.scheduler.pending_by_bucket()
+                                    if self.scheduler else {}),
             "bucket_cap": self.bucket_cap,
             "deadline_s": self.serve_cfg.deadline_s,
             "warm_shapes": (self.pool.warm_shapes_now() if self.pool else []),
@@ -481,59 +538,6 @@ class CleaningService:
         tracing.count("service_buckets_dispatched")
         self.worker.submit(entries)
 
-    def note_dispatch_ok(self) -> None:
-        with self._mode_lock:
-            self._consecutive_failures = 0
-
-    def note_dispatch_failure(self, exc) -> None:
-        # Count-then-demote under the mode lock (the worker and auditor
-        # threads both reach the demotion transition); side effects fire
-        # outside it, exactly once, on the thread that flipped the mode.
-        with self._mode_lock:
-            self._consecutive_failures += 1
-            n_failures = self._consecutive_failures
-            demote = (self.backend_mode == "jax"
-                      and n_failures >= self.serve_cfg.demote_after)
-            if demote:
-                self.backend_mode = "numpy"
-        if demote:
-            tracing.count("service_backend_demotions")
-            # The top rung of the fault ladder: dump the flight ring — the
-            # post-mortem of what led to a service-wide demotion is worth a
-            # file even when nobody configured telemetry.
-            flight.note("service_demoted", error=str(exc))
-            flight.dump(f"service_demotion: {exc}", self.flight_dir)
-            print(f"ict-serve: {n_failures} consecutive "
-                  f"bucket dispatches failed (last: {exc}); demoting the "
-                  "service to the numpy oracle backend", file=sys.stderr)
-
-    def note_audit_divergence(self, record: dict) -> None:
-        """The shadow auditor confirmed a served mask differed from the
-        oracle.  Repeated confirmed divergences demote the service the
-        same way repeated dispatch failures do (the worker ladder's top
-        rung): a route that keeps producing wrong masks is worse than a
-        route that keeps crashing."""
-        with self._mode_lock:
-            self._audit_divergences += 1
-            n_div = self._audit_divergences
-            demote = (self.backend_mode == "jax"
-                      and n_div >= self.serve_cfg.demote_after)
-            if demote:
-                self.backend_mode = "numpy"
-        if demote:
-            tracing.count("service_backend_demotions")
-            flight.note("service_demoted_audit",
-                        n_divergences=n_div,
-                        job_id=record.get("job_id", ""))
-            flight.dump(f"audit_divergence_demotion: "
-                        f"{n_div} confirmed divergences "
-                        f"(last: job {record.get('job_id', '?')})",
-                        self.flight_dir)
-            print(f"ict-serve: {n_div} confirmed audit "
-                  "divergences vs the numpy oracle; demoting the service "
-                  "to the oracle backend (repro bundles under "
-                  f"{self.repro_dir})", file=sys.stderr)
-
 
 # --- CLI ---
 
@@ -550,6 +554,10 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8750,
                    help="HTTP port (0 = ephemeral; default 8750)")
+    p.add_argument("--replica_id", default="", metavar="ID",
+                   help="stable fleet identity, echoed on /healthz and "
+                        "every POST /jobs 202 so trace logs attribute jobs "
+                        "to replicas (default: mint one per process life)")
     p.add_argument("--bucket_cap", type=int, default=0, metavar="N",
                    help="archives per sharded dispatch (0 = the mesh's "
                         "data-parallel extent; clamped to a power of two)")
@@ -641,6 +649,7 @@ def serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
         spool_dir=args.spool,
         host=args.host,
         port=args.port,
+        replica_id=args.replica_id,
         bucket_cap=args.bucket_cap,
         deadline_s=args.deadline_s,
         loaders=args.loaders,

@@ -57,6 +57,11 @@ class Job:
     audit: bool = False             # submitter asked for a shadow-oracle
                                     # parity audit of this job (obs/audit.py;
                                     # ICT_AUDIT_RATE samples the rest)
+    idem_key: str = ""              # submitter-supplied idempotency key
+                                    # (the fleet router's failover path):
+                                    # a re-submission carrying the same key
+                                    # dedupes against this job instead of
+                                    # running it twice (service/context.py)
     # Shadow-audit outcome, re-persisted once the background replay
     # finishes: mask_identical, n_mask_diffs, score drift vs the
     # documented bound, and the repro-bundle path on a divergence.

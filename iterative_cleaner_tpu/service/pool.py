@@ -42,11 +42,16 @@ def warm_batch_sizes(bucket_cap: int) -> list[int]:
 
 
 class WarmPool:
-    def __init__(self, cfg, mesh, bucket_cap: int, quiet: bool = False) -> None:
-        self.cfg = cfg
-        self.mesh = mesh
+    """Constructed purely from a :class:`~.context.ReplicaContext` — the
+    pool holds no process-global state, so fleet tests can warm three
+    replicas' pools in one process without them seeing each other."""
+
+    def __init__(self, ctx, bucket_cap: int) -> None:
+        self.ctx = ctx
+        self.cfg = ctx.clean_cfg
+        self.mesh = ctx.mesh
         self.bucket_cap = int(bucket_cap)
-        self.quiet = quiet          # gates info lines; warnings stay loud
+        self.quiet = ctx.serve_cfg.quiet  # gates info lines; warnings stay loud
         self.declared: tuple = ()   # shape classes declared at startup
 
     def warm_shape(self, shape) -> int:

@@ -45,6 +45,13 @@ class Entry:
     arrived_s: float            # time.monotonic() — immune to clock steps
 
 
+def bucket_label(shape) -> str:
+    """Canonical shape-bucket label, ``8x16x64`` — one grammar shared by
+    the ``--warm`` CLI spec, ``/healthz`` bucket depths, and the fleet
+    router's placement keys."""
+    return "x".join(str(int(v)) for v in shape)
+
+
 def pow2_chunks(n: int, cap: int) -> list[int]:
     """Split ``n`` into power-of-two chunk sizes <= cap, largest first
     (5, cap 4 -> [4, 1]) — the closed set of batch sizes the scheduler can
@@ -122,3 +129,13 @@ class ShapeBucketScheduler:
     def pending_count(self) -> int:
         with self._lock:
             return sum(len(g) for g in self._buckets.values())
+
+    def pending_by_bucket(self) -> dict[str, int]:
+        """Queued-cube depth per shape bucket, keyed by the ``NSUBxNCHANx
+        NBIN`` label (the ``--warm`` spec grammar).  This is the
+        bucket-resolved signal the fleet router's affinity placement
+        reads off ``/healthz`` — the aggregate depths alone cannot tell
+        it WHICH replica is already working a shape."""
+        with self._lock:
+            return {bucket_label(shape): len(group)
+                    for shape, group in self._buckets.items()}

@@ -3,20 +3,23 @@
 One thread owns the device (JAX dispatch is not re-entrant across threads
 without care, and the bucket executables serialize on the chip anyway); the
 loader threads and the HTTP server stay responsive while it runs.  The
+worker is constructed purely from a :class:`~.context.ReplicaContext`, so
+fleet tests run several workers in one process without shared state.  The
 failure ladder, top to bottom:
 
 1. a job whose archive fails to DECODE never reaches this worker — the
    loader marks it ``error`` alone (the parallel/batch isolation rule);
-2. a sharded bucket dispatch that throws is retried with exponential
-   backoff (``dispatch_retries`` / ``retry_backoff_s``) — the dev-tunnel
-   failure mode is a transient RPC error on first contact (bench.py
-   learned this in r01);
+2. a sharded bucket dispatch that throws is retried with full-jitter
+   exponential backoff (``dispatch_retries`` / ``retry_backoff_s``,
+   utils/backoff.py — jittered so replicas recovering together don't
+   thundering-herd the spool; the dev-tunnel failure mode is a transient
+   RPC error on first contact, bench.py learned this in r01);
 3. retries exhausted: every still-unfinished job in the bucket degrades to
    the numpy ORACLE backend, individually — slower, but masks are the
    oracle's by definition, and one poisoned cube cannot take its bucket
    siblings down;
-4. repeated bucket failures demote the whole service to oracle mode
-   (daemon.note_dispatch_failure), the serving analog of the CLI's
+4. repeated bucket failures demote the whole replica to oracle mode
+   (context.note_dispatch_failure), the serving analog of the CLI's
    wedged-tunnel CPU demotion (utils/device_probe.py).
 """
 
@@ -42,6 +45,7 @@ from iterative_cleaner_tpu.obs import (
 )
 from iterative_cleaner_tpu.service.jobs import TERMINAL, Job
 from iterative_cleaner_tpu.service.scheduler import Entry
+from iterative_cleaner_tpu.utils import backoff
 
 _STOP = object()
 
@@ -49,9 +53,10 @@ _STOP = object()
 class DispatchWorker(threading.Thread):
     """Consumes entry groups (same-shape buckets) from the scheduler."""
 
-    def __init__(self, service) -> None:
-        super().__init__(daemon=True, name="ict-serve-dispatch")
-        self.service = service
+    def __init__(self, ctx) -> None:
+        super().__init__(daemon=True,
+                         name=f"ict-serve-dispatch-{ctx.replica_id}")
+        self.ctx = ctx
         self._q: queue.Queue = queue.Queue()
 
     def submit(self, entries: list[Entry]) -> None:
@@ -79,14 +84,14 @@ class DispatchWorker(threading.Thread):
     # --- the failure ladder ---
 
     def _dispatch(self, entries: list[Entry]) -> None:
-        svc = self.service
+        ctx = self.ctx
         for e in entries:
             e.job.state = "running"
-            svc.spool.save(e.job)
+            ctx.spool.save(e.job)
             if events.active():
                 events.emit("dispatch", trace_id=e.job.trace_id,
                             job_id=e.job.id, bucket_size=len(entries),
-                            backend=svc.backend_mode)
+                            backend=ctx.backend_mode)
         # Per-job profiler capture (obs/profiling): requested at submit
         # time, taken around this bucket's whole dispatch (device work is
         # bucket-granular — the capture necessarily covers the siblings
@@ -94,7 +99,7 @@ class DispatchWorker(threading.Thread):
         # silently when the profiler is busy with an operator capture.
         want_profile = [e for e in entries if e.job.profile]
         with profiling.maybe_capture(
-                svc.profile_root,
+                ctx.profile_root,
                 tag=want_profile[0].job.id if want_profile else "",
                 want=bool(want_profile)) as profile_dir:
             if profile_dir:
@@ -103,8 +108,8 @@ class DispatchWorker(threading.Thread):
             self._dispatch_routed(entries)
 
     def _dispatch_routed(self, entries: list[Entry]) -> None:
-        svc = self.service
-        if svc.backend_mode == "jax":
+        ctx = self.ctx
+        if ctx.backend_mode == "jax":
             err = self._try_sharded(entries)
             if err is None:
                 return
@@ -112,14 +117,14 @@ class DispatchWorker(threading.Thread):
             # A fault-ladder trip is exactly the moment the flight ring
             # exists for: persist what the daemon was doing (dispatches,
             # phase timings, retries) next to the spool.
-            flight.dump(f"oracle_fallback: {err}", svc.flight_dir)
+            flight.dump(f"oracle_fallback: {err}", ctx.flight_dir)
             print(f"ict-serve: sharded dispatch failed after retries ({err}); "
                   f"serving {len(entries)} job(s) via the numpy oracle",
                   file=sys.stderr)
         # "oracle" = the configured numpy route; "oracle-fallback" = the
         # degraded one — an intentionally-numpy deployment must not raise
         # permanent fallback alarms.
-        label = ("oracle" if svc.clean_cfg.backend == "numpy"
+        label = ("oracle" if ctx.clean_cfg.backend == "numpy"
                  else "oracle-fallback")
         for e in entries:
             if e.job.state not in TERMINAL:
@@ -127,27 +132,30 @@ class DispatchWorker(threading.Thread):
 
     def _try_sharded(self, entries: list[Entry]):
         """Bounded retry around one bucket dispatch; returns the final
-        exception, or None on success."""
-        svc = self.service
-        delay = svc.serve_cfg.retry_backoff_s
+        exception, or None on success.  Retry delays draw full jitter
+        from the replica's private RNG (utils/backoff.py) so N replicas
+        recovering from the same incident spread their re-contacts
+        instead of herding — deterministic under ICT_BACKOFF_SEED."""
+        ctx = self.ctx
         last = None
-        for attempt in range(1 + svc.serve_cfg.dispatch_retries):
+        for attempt in range(1 + ctx.serve_cfg.dispatch_retries):
             live = [e for e in entries if e.job.state not in TERMINAL]
             if not live:
                 return None
             if attempt:
                 tracing.count("service_dispatch_retries")
-                time.sleep(delay)
-                delay *= 2
+                time.sleep(backoff.full_jitter(
+                    ctx.serve_cfg.retry_backoff_s, attempt - 1,
+                    rng=ctx.backoff_rng))
             for e in live:
                 e.job.attempts += 1
             try:
                 self._dispatch_sharded(live)
-                svc.note_dispatch_ok()
+                ctx.note_dispatch_ok()
                 return None
             except Exception as exc:  # noqa: BLE001 — retried, then degraded
                 last = exc
-        svc.note_dispatch_failure(last)
+        ctx.note_dispatch_failure(last)
         return last
 
     def _dispatch_sharded(self, entries: list[Entry]) -> None:
@@ -160,7 +168,7 @@ class DispatchWorker(threading.Thread):
             _finish_bucket,
         )
 
-        svc = self.service
+        ctx = self.ctx
         items = [BatchItem(path=e.job.path, archive=e.archive)
                  for e in entries]
         Db = np.stack([e.D for e in entries])
@@ -191,7 +199,7 @@ class DispatchWorker(threading.Thread):
         ok = False
         try:
             _finish_bucket(items, list(range(len(items))), Db, w0b,
-                           svc.clean_cfg, svc.mesh, on_item=on_item,
+                           ctx.clean_cfg, ctx.mesh, on_item=on_item,
                            # The per-job iteration timeline (GET /jobs/<id>/
                            # trace) costs a history fetch per bucket; pay it
                            # only when the operator turned forensics on.
@@ -217,12 +225,12 @@ class DispatchWorker(threading.Thread):
         # telemetry, never the jobs.  Manifests were already written
         # terminal by on_item, so the analysis is re-persisted onto them
         # (GET /jobs/<id> falls back to the spool after retire()).
-        analysis = obs_memory.analyze_batch_route(Db.shape, svc.clean_cfg)
+        analysis = obs_memory.analyze_batch_route(Db.shape, ctx.clean_cfg)
         if analysis:
             for e in entries:
                 e.job.exec_analysis = analysis
                 try:
-                    svc.spool.save(e.job)
+                    ctx.spool.save(e.job)
                 except Exception:  # noqa: BLE001 — telemetry must not fail
                     pass           # a job that already served its result
 
@@ -233,11 +241,11 @@ class DispatchWorker(threading.Thread):
         from iterative_cleaner_tpu.core.cleaner import clean_cube
         from iterative_cleaner_tpu.parallel.batch import finalize_weights
 
-        svc = self.service
+        ctx = self.ctx
         try:
             with events.trace_scope(e.job.trace_id), \
                     tracing.phase("service_oracle"):
-                cfg = svc.clean_cfg.replace(backend="numpy")
+                cfg = ctx.clean_cfg.replace(backend="numpy")
                 res = clean_cube(e.D, e.w0, cfg)
                 final_w, rfi = finalize_weights(res.weights, cfg)
                 self._emit(e, final_w, res.loops, res.converged, rfi,
@@ -263,10 +271,10 @@ class DispatchWorker(threading.Thread):
         from iterative_cleaner_tpu.io.base import get_io
         from iterative_cleaner_tpu.models.surgical import apply_output_policy
 
-        svc = self.service
+        ctx = self.ctx
         job = e.job
-        cleaned = apply_output_policy(e.archive, np.asarray(weights), svc.clean_cfg)
-        o_name = output_name(svc.clean_cfg, e.archive, job.path)
+        cleaned = apply_output_policy(e.archive, np.asarray(weights), ctx.clean_cfg)
+        o_name = output_name(ctx.clean_cfg, e.archive, job.path)
         atomic_save(get_io(job.path), cleaned, o_name)
         job.out_path = o_name
         job.loops = int(loops)
@@ -296,12 +304,12 @@ class DispatchWorker(threading.Thread):
         # queue skips, never blocks.  Jobs the oracle itself served are
         # only audited on explicit request — a sampled replay of the
         # oracle against the oracle proves nothing.
-        auditor = getattr(svc, "auditor", None)
+        auditor = ctx.auditor
         if (auditor is not None
                 and (job.audit or served_by == "sharded")
-                and obs_audit.should_audit(job.audit, svc.audit_rate())):
+                and obs_audit.should_audit(job.audit, ctx.audit_rate())):
             auditor.submit(job, e.D, e.w0, np.asarray(weights), scores,
-                           served_by, svc.clean_cfg)
+                           served_by, ctx.clean_cfg)
         job.finished_s = time.time()
         # Persist the done-stamped manifest BEFORE the in-memory state
         # flips: drain() keys off ``job.state``, so flipping first opens a
@@ -310,9 +318,9 @@ class DispatchWorker(threading.Thread):
         # a served job without its quality/profile fields (observed as a
         # test flake).  A copy carries the stamp; the shared field refs
         # are only read for serialization.
-        svc.spool.save(dataclasses.replace(job, state="done"))
+        ctx.spool.save(dataclasses.replace(job, state="done"))
         job.state = "done"
-        svc.retire(job)
+        ctx.retire(job)
         tracing.count("service_jobs_done")
         tracing.count_labeled("jobs_served_total", {"route": served_by})
         if events.active():
@@ -336,8 +344,8 @@ class DispatchWorker(threading.Thread):
             events.emit("job_error", trace_id=job.trace_id, job_id=job.id,
                         error=msg)
         try:
-            self.service.spool.save(job)
-            self.service.retire(job)
+            self.ctx.spool.save(job)
+            self.ctx.retire(job)
         except Exception as exc:  # noqa: BLE001 — keep the job in memory:
             # with the manifest unwritten, the in-memory record is the only
             # true view of its state (GET /jobs/<id> reads it first).

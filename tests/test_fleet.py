@@ -1,0 +1,740 @@
+"""ict-fleet end to end: 3+ in-process replicas behind one router.
+
+The acceptance contract (ISSUE 9): placements spread by load, warm-bucket
+affinity wins ties, tenant quotas 429 and weighted fair queueing orders
+grants under contention, a replica killed mid-queue has its undispatched
+jobs re-routed with every job completing exactly once and masks
+bit-identical to the numpy oracle, drain-then-stop loses nothing, and the
+router's own /metrics renders under the strict Prometheus grammar.
+
+Timing discipline: routers are built with a dormant poll loop
+(``poll_interval_s`` huge) and the tests drive ``poll_tick()`` by hand, so
+death detection and failover sweeps are deterministic instead of slept-for.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from test_observability import _parse_prometheus
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube
+from iterative_cleaner_tpu.fleet.registry import ReplicaRegistry
+from iterative_cleaner_tpu.fleet.router import FleetConfig, FleetRouter
+from iterative_cleaner_tpu.fleet.tenants import (
+    QuotaExceeded,
+    TenantAdmission,
+    WeightedFairQueue,
+)
+from iterative_cleaner_tpu.io.npz import NpzIO
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.ops.preprocess import preprocess
+from iterative_cleaner_tpu.parallel.batch import finalize_weights
+from iterative_cleaner_tpu.parallel.mesh import make_mesh
+from iterative_cleaner_tpu.service import CleaningService, ServeConfig
+from iterative_cleaner_tpu.service.jobs import TERMINAL
+from iterative_cleaner_tpu.utils import backoff, tracing
+
+
+def _write(tmp_path, name, nsub=4, seed=0):
+    p = str(tmp_path / name)
+    NpzIO().save(make_archive(nsub=nsub, nchan=16, nbin=64, seed=seed), p)
+    return p
+
+
+def _oracle_weights(path, max_iter=3):
+    cfg = CleanConfig(backend="numpy", max_iter=max_iter)
+    w, _rfi = finalize_weights(
+        clean_cube(*preprocess(NpzIO().load(path)), cfg).weights, cfg)
+    return w
+
+
+def _start_replica(tmp_path, tag, backend="numpy", mesh=None, **kw):
+    defaults = dict(spool_dir=str(tmp_path / f"spool_{tag}"), port=0,
+                    replica_id=tag, deadline_s=0.2, quiet=True,
+                    retry_backoff_s=0.01,
+                    clean=CleanConfig(backend=backend, max_iter=3,
+                                      quiet=True, no_log=True))
+    defaults.update(kw)
+    svc = CleaningService(ServeConfig(**defaults), mesh=mesh)
+    svc.start()
+    return svc
+
+
+def _start_router(*svcs, **kw):
+    defaults = dict(
+        replicas=tuple(f"http://127.0.0.1:{s.port}" for s in svcs),
+        port=0, poll_interval_s=999.0, dead_after=2, quiet=True,
+        retry_backoff_s=0.01, queue_timeout_s=5.0)
+    defaults.update(kw)
+    router = FleetRouter(FleetConfig(**defaults))
+    router.start()
+    return router
+
+
+def _post_job(router, body, headers=None, expect_error=False):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router.port}/jobs",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        return json.load(urllib.request.urlopen(req, timeout=30))
+    except urllib.error.HTTPError as exc:
+        if expect_error:
+            return exc
+        raise
+
+
+def _get(router, route, expect_error=False):
+    try:
+        return json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}{route}", timeout=30))
+    except urllib.error.HTTPError as exc:
+        if expect_error:
+            return exc.code
+        raise
+
+
+def _await_fleet_terminal(router, job_ids, timeout_s=120.0):
+    """Poll jobs through the router until every placement is terminal;
+    drives poll_tick so status refresh doesn't depend on the dormant
+    background loop."""
+    deadline = time.time() + timeout_s
+    states = {}
+    while time.time() < deadline:
+        router.poll_tick()
+        states = {jid: _get(router, f"/jobs/{jid}") for jid in job_ids}
+        if all(s.get("state") in TERMINAL for s in states.values()):
+            return states
+        time.sleep(0.05)
+    raise AssertionError(f"jobs not terminal within {timeout_s}s: "
+                         f"{ {j: s.get('state') for j, s in states.items()} }")
+
+
+# --- units: WFQ, quotas, backoff, registry ---
+
+
+class TestWeightedFairQueue:
+    def test_weighted_grant_order_is_exact(self):
+        """Weight 3 beats weight 1 three-to-one under sustained
+        contention — the virtual-finish-time order is deterministic."""
+        q = WeightedFairQueue(weights={"a": 1.0, "b": 3.0})
+        for i in range(4):
+            q.push("a", f"a{i}")
+        for i in range(4):
+            q.push("b", f"b{i}")
+        order = [q.pop()[1] for _ in range(8)]
+        assert order == ["b0", "b1", "a0", "b2", "b3", "a1", "a2", "a3"]
+
+    def test_idle_tenant_rejoins_at_current_virtual_time(self):
+        """A tenant idle through the contention must not bank credit nor
+        inherit a starvation debt: its next grant queues at the current
+        service level."""
+        q = WeightedFairQueue()
+        for i in range(3):
+            q.push("busy", f"busy{i}")
+        while len(q):
+            q.pop()
+        q.push("idle", "idle0")
+        q.push("busy", "busy3")
+        # busy's last finish (3.0) equals the virtual clock, so both
+        # tenants race from the same start: FIFO tie-break, idle first.
+        assert [q.pop()[1], q.pop()[1]] == ["idle0", "busy3"]
+
+    def test_unknown_tenant_uses_default_weight(self):
+        q = WeightedFairQueue(weights={"vip": 2.0}, default_weight=1.0)
+        q.push("anon", "x")
+        q.push("vip", "y")
+        assert q.pop() == ("vip", "y")   # 0.5 finish beats 1.0
+
+    def test_finish_stamps_are_pruned_not_hoarded(self):
+        """One dict entry per distinct tenant name EVER seen would make
+        the unauthenticated X-ICT-Tenant header an unbounded-memory hole;
+        stamps the virtual clock has passed are pruned on pop."""
+        q = WeightedFairQueue()
+        for i in range(200):
+            q.push(f"tenant-{i}", i)
+        while len(q):
+            q.pop()
+        assert q._last_finish == {}
+        # and pruning does not disturb fairness for live tenants
+        q.push("a", "a0")
+        q.push("b", "b0")
+        assert q.pop()[1] == "a0" and q.pop()[1] == "b0"
+
+
+class TestTenantAdmission:
+    def test_quota_checked_and_counted_atomically(self):
+        adm = TenantAdmission(quotas={"t": 2})
+        adm.admit("t")
+        adm.admit("t")
+        with pytest.raises(QuotaExceeded):
+            adm.admit("t")
+        adm.release("t")
+        adm.admit("t")                       # freed slot readmits
+        adm.admit("other")                   # default quota 0 = unbounded
+        assert adm.open_count("t") == 2
+
+    def test_release_never_goes_negative(self):
+        adm = TenantAdmission(quotas={"t": 1})
+        adm.release("t")
+        adm.admit("t")                       # still admits after a stray release
+        assert adm.open_count("t") == 1
+
+
+def test_full_jitter_deterministic_under_seed(monkeypatch):
+    """The ICT_BACKOFF_SEED test hook pins every retry schedule: same
+    seed, same delays — and delays respect the cap and the expected
+    exponential envelope."""
+    monkeypatch.setenv("ICT_BACKOFF_SEED", "42")
+    a = [backoff.full_jitter(0.25, k, rng=backoff.make_rng())
+         for k in range(6)]
+    b = [backoff.full_jitter(0.25, k, rng=backoff.make_rng())
+         for k in range(6)]
+    # each draw used a FRESH seeded rng, so per-attempt values replay
+    assert a == b
+    # one rng drawn SEQUENTIALLY replays too, and the env seed and an
+    # explicit seed produce the same stream
+    rng_env, rng_42 = backoff.make_rng(), backoff.make_rng(42)
+    seq1 = [backoff.full_jitter(0.25, k, rng=rng_env) for k in range(8)]
+    seq2 = [backoff.full_jitter(0.25, k, rng=rng_42) for k in range(8)]
+    assert seq1 == seq2
+    for k, d in enumerate(seq1):
+        assert 0.0 <= d <= min(backoff.DEFAULT_CAP_S, 0.25 * 2 ** k)
+    monkeypatch.delenv("ICT_BACKOFF_SEED")
+    assert isinstance(backoff.full_jitter(0.25, 0), float)
+
+
+class _FakeClient:
+    """Scripted /healthz responses for registry units: a dict per URL, or
+    an exception instance to raise."""
+
+    def __init__(self, script):
+        self.script = script
+
+    def health(self, base_url):
+        out = self.script[base_url]
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+
+class TestReplicaRegistry:
+    def test_death_after_n_failures_and_revival(self):
+        reg = ReplicaRegistry(["http://a", "http://b"], dead_after=2)
+        ok = {"replica_id": "ra", "draining": False}
+        reg.poll_once(_FakeClient({"http://a": ok,
+                                   "http://b": {"replica_id": "rb"}}))
+        assert {r.replica_id for r in reg.candidates()} == {"ra", "rb"}
+        boom = ConnectionError("down")
+        dead = reg.poll_once(_FakeClient({"http://a": ok, "http://b": boom}))
+        assert dead == []                     # first failure: countdown only
+        dead = reg.poll_once(_FakeClient({"http://a": ok, "http://b": boom}))
+        assert [r.replica_id for r in dead] == ["rb"]
+        assert {r.replica_id for r in reg.candidates()} == {"ra"}
+        # death is reported exactly once
+        assert reg.poll_once(_FakeClient(
+            {"http://a": ok, "http://b": boom})) == []
+        # one healthy poll revives
+        reg.poll_once(_FakeClient({"http://a": ok,
+                                   "http://b": {"replica_id": "rb"}}))
+        assert {r.replica_id for r in reg.candidates()} == {"ra", "rb"}
+
+    def test_draining_replica_is_no_candidate(self):
+        reg = ReplicaRegistry(["http://a"], dead_after=2)
+        reg.poll_once(_FakeClient(
+            {"http://a": {"replica_id": "ra", "draining": True}}))
+        assert reg.candidates() == []
+        snap = reg.snapshot()[0]
+        assert snap["draining"] is True and snap["alive"] is True
+
+    def test_submission_failures_feed_the_same_countdown(self):
+        reg = ReplicaRegistry(["http://a"], dead_after=2)
+        reg.poll_once(_FakeClient({"http://a": {"replica_id": "ra"}}))
+        assert reg.note_unreachable("http://a") is None
+        killed = reg.note_unreachable("http://a")
+        assert killed is not None and killed.replica_id == "ra"
+        assert reg.candidates() == []
+
+
+def test_ranked_candidates_affinity_and_load(tmp_path):
+    """The placement policy in isolation: warm bucket beats a tie, a
+    queued bucket earns the smaller bonus, heavy load still wins over
+    warmth."""
+    router = FleetRouter(FleetConfig(replicas=("http://a", "http://b")))
+    reg = router.registry
+    warm = {"replica_id": "rw", "warm_shapes": [[4, 16, 64]],
+            "open_jobs": 0}
+    cold = {"replica_id": "rc", "open_jobs": 0}
+    reg.poll_once(_FakeClient({"http://a": cold, "http://b": warm}))
+    # tie on load: the warm replica wins the 4x16x64 bucket despite
+    # losing the replica-id tie-break
+    ranked = router._ranked_candidates("4x16x64", set())
+    assert [r.replica_id for r in ranked] == ["rw", "rc"]
+    # no bucket hint: pure load + id tie-break
+    assert [r.replica_id
+            for r in router._ranked_candidates("", set())] == ["rc", "rw"]
+    # a deeply backlogged warm replica loses to an idle cold one
+    warm_busy = dict(warm, open_jobs=6)
+    reg.poll_once(_FakeClient({"http://a": cold, "http://b": warm_busy}))
+    assert [r.replica_id for r in
+            router._ranked_candidates("4x16x64", set())] == ["rc", "rw"]
+    # a replica with the bucket QUEUED gets the smaller bonus: one queued
+    # cube (load +1, bonus -1.25) beats an idle cold replica
+    queued = {"replica_id": "rq", "bucket_queue_depths": {"4x16x64": 1},
+              "bucketed_cubes": 1}
+    reg.poll_once(_FakeClient({"http://a": cold, "http://b": queued}))
+    assert [r.replica_id for r in
+            router._ranked_candidates("4x16x64", set())] == ["rq", "rc"]
+
+
+# --- HTTP end to end (numpy replicas: infra semantics, fast) ---
+
+
+def test_placement_spread_and_replica_attribution(tmp_path):
+    """Least-loaded placement spreads a burst across equal replicas; the
+    202 carries the serving replica_id (the satellite contract) and the
+    router id; job reads through the router resolve the fleet id."""
+    paths = [_write(tmp_path, f"s{i}.npz", seed=10 + i) for i in range(3)]
+    svcs = [_start_replica(tmp_path, f"fl-{t}") for t in "abc"]
+    router = _start_router(*svcs)
+    try:
+        replies = [_post_job(router, {"path": p}) for p in paths]
+        assert sorted(r["replica_id"] for r in replies) == [
+            "fl-a", "fl-b", "fl-c"]
+        assert all(r["router_id"] == router.router_id for r in replies)
+        states = _await_fleet_terminal(router, [r["id"] for r in replies])
+        assert all(s["state"] == "done" for s in states.values())
+        for p, r in zip(paths, replies):
+            got = states[r["id"]]
+            assert got["replica_id"] == r["replica_id"]
+            np.testing.assert_array_equal(
+                NpzIO().load(got["out_path"]).weights, _oracle_weights(p))
+        assert _get(router, "/jobs/nope", expect_error=True) == 404
+        assert _get(router, "/nothing", expect_error=True) == 404
+        health = _get(router, "/healthz")
+        assert health["replicas_alive"] == 3
+        assert health["open_placements"] == 0
+    finally:
+        router.stop()
+        for s in svcs:
+            s.stop()
+
+
+def test_tenant_quota_429_and_wfq_metrics(tmp_path):
+    """Per-tenant quota breach is 429 + Retry-After; the freed quota
+    readmits after the placement is observed terminal; admissions and
+    rejections land on the router's /metrics."""
+    p = _write(tmp_path, "q.npz", seed=30)
+    # A parked replica (huge deadline, wide bucket) keeps placements open.
+    svc = _start_replica(tmp_path, "fl-q", deadline_s=3600.0, bucket_cap=8)
+    router = _start_router(svc, tenant_quotas={"t1": 1})
+    try:
+        first = _post_job(router, {"path": p},
+                          headers={"X-ICT-Tenant": "t1"})
+        assert first["tenant"] == "t1"
+        exc = _post_job(router, {"path": p}, headers={"X-ICT-Tenant": "t1"},
+                        expect_error=True)
+        assert exc.code == 429
+        assert exc.headers["Retry-After"]
+        # an undeclared tenant rides the unbounded default quota
+        other = _post_job(router, {"path": p},
+                          headers={"X-ICT-Tenant": "t2"})
+        assert other["tenant"] == "t2"
+        # finish the parked work, observe it through the router: quota
+        # frees.  Wait for BOTH accepted jobs to be decoded into their
+        # parked bucket first — set_draining flushes what is bucketed
+        # NOW, and a job still in the load queue would re-park forever.
+        deadline = time.time() + 60
+        while svc.scheduler.pending_count() < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        svc.set_draining(True)    # flushes parked buckets
+        assert svc.drain(60)
+        _await_fleet_terminal(router, [first["id"], other["id"]])
+        assert router.admission.open_count("t1") == 0
+        svc.set_draining(False)
+        router.poll_tick()   # the registry must observe the undrain
+        again = _post_job(router, {"path": p},
+                          headers={"X-ICT-Tenant": "t1"})
+        assert again["tenant"] == "t1"
+        m = router.metrics
+        assert m.counter_value("fleet_tenant_rejections_total",
+                               {"tenant": "t1"}) == 1
+        assert m.counter_value("fleet_tenant_admissions_total",
+                               {"tenant": "t1"}) == 2
+        assert m.counter_value("fleet_tenant_admissions_total",
+                               {"tenant": "t2"}) == 1
+    finally:
+        router.stop()
+        svc.stop()
+
+
+def test_kill_replica_mid_queue_failover_exactly_once(tmp_path):
+    """The tentpole failure story: a replica dies with accepted-but-
+    undispatched jobs parked in its buckets; the router detects death,
+    re-routes those placements with their idempotency keys, and every
+    job completes EXACTLY once fleet-wide with oracle-identical masks.
+    Trace context and fleet events ride the whole path."""
+    paths = [_write(tmp_path, f"k{i}.npz", seed=40 + i) for i in range(4)]
+    # fl-a parks everything it accepts; fl-b drains fast.
+    svc_a = _start_replica(tmp_path, "fl-a", deadline_s=3600.0, bucket_cap=8)
+    svc_b = _start_replica(tmp_path, "fl-b")
+    telemetry = tmp_path / "fleet_events.jsonl"
+    router = _start_router(svc_a, svc_b, telemetry=str(telemetry))
+    before_done = tracing.counters_snapshot().get("service_jobs_done", 0)
+    try:
+        replies = [_post_job(router, {"path": p}) for p in paths]
+        on_a = [r for r in replies if r["replica_id"] == "fl-a"]
+        assert on_a, "least-loaded placement must have used fl-a"
+        # Wait until fl-a decoded and PARKED its jobs, then crash it.
+        deadline = time.time() + 60
+        while (svc_a.scheduler.pending_count() < len(on_a)
+               and time.time() < deadline):
+            time.sleep(0.02)
+        assert svc_a.scheduler.pending_count() == len(on_a)
+        svc_a.stop()
+        # Two dormant-loop ticks: death countdown (dead_after=2) + the
+        # failover sweep that re-routes fl-a's open placements to fl-b.
+        router.poll_tick()
+        router.poll_tick()
+        states = _await_fleet_terminal(router, [r["id"] for r in replies])
+        assert all(s["state"] == "done" for s in states.values())
+        for p, r in zip(paths, replies):
+            got = states[r["id"]]
+            np.testing.assert_array_equal(
+                NpzIO().load(got["out_path"]).weights, _oracle_weights(p))
+        # re-routed jobs are attributed to the survivor under their
+        # ORIGINAL fleet ids
+        for r in on_a:
+            assert states[r["id"]]["replica_id"] == "fl-b"
+        # exactly once, fleet-wide: the shared in-process completion
+        # counter moved by exactly len(paths)
+        done_delta = tracing.counters_snapshot().get(
+            "service_jobs_done", 0) - before_done
+        assert done_delta == len(paths)
+        assert router.metrics.counter_total(
+            "fleet_failovers_total") == len(on_a)
+        # trace context crossed both hops; fleet events hit the log
+        events = [json.loads(line)
+                  for line in telemetry.read_text().splitlines()]
+        by_kind = {}
+        for e in events:
+            by_kind.setdefault(e["event"], []).append(e)
+        placed_traces = {e["trace_id"] for e in by_kind["fleet_placement"]}
+        assert len(by_kind["fleet_placement"]) == len(paths)
+        assert len(by_kind["fleet_failover"]) == len(on_a)
+        for e in by_kind["fleet_failover"]:
+            assert e["from_replica"] == "fl-a"
+            assert e["to_replica"] == "fl-b"
+            assert e["trace_id"] in placed_traces
+        # the replica adopted the router's trace id (one id end to end)
+        for r in replies:
+            assert states[r["id"]]["trace_id"] == r["trace_id"]
+            assert r["trace_id"] in placed_traces
+    finally:
+        router.stop()
+        svc_b.stop()
+
+
+def test_drain_then_stop_loses_nothing(tmp_path):
+    """Drain semantics: a draining replica gets no new placements but
+    finishes every accepted job; drain-then-stop ends with zero lost
+    jobs and the drain surfaced on /healthz."""
+    paths = [_write(tmp_path, f"d{i}.npz", seed=60 + i) for i in range(4)]
+    svc_a = _start_replica(tmp_path, "fl-a", deadline_s=1.0, bucket_cap=8)
+    svc_b = _start_replica(tmp_path, "fl-b")
+    router = _start_router(svc_a, svc_b)
+    try:
+        first = _post_job(router, {"path": paths[0]})
+        assert first["replica_id"] == "fl-a"   # tie-break: fl-a first
+        # drain fl-a THROUGH the router (covers the proxy route); the
+        # registry refreshes synchronously
+        resp = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/replicas/fl-a/drain",
+            data=b"{}"), timeout=30)
+        assert json.load(resp)["draining"] is True
+        assert _get(router, "/healthz")["replicas_alive"] == 1
+        # every subsequent placement avoids the draining replica
+        more = [_post_job(router, {"path": p}) for p in paths[1:]]
+        assert {r["replica_id"] for r in more} == {"fl-b"}
+        # the draining replica still finishes its accepted job
+        states = _await_fleet_terminal(
+            router, [first["id"]] + [r["id"] for r in more])
+        assert all(s["state"] == "done" for s in states.values())
+        assert states[first["id"]]["replica_id"] == "fl-a"
+        # direct submissions to the draining replica are refused 503
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc_a.port}/jobs",
+            data=json.dumps({"path": paths[0]}).encode())
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=30)
+        assert exc_info.value.code == 503
+        assert svc_a.drain(30)                 # zero lost jobs
+        svc_a.stop()
+        router.poll_tick()
+        assert _get(router, "/healthz")["replicas_alive"] == 1
+    finally:
+        router.stop()
+        svc_b.stop()
+        try:
+            svc_a.stop()
+        except Exception:  # noqa: BLE001 — already stopped in the happy path
+            pass
+
+
+def test_router_metrics_strict_prometheus_grammar(tmp_path):
+    """The router's own /metrics: every line passes the strict exposition
+    regex, and the placement/failover/tenant/queue-depth families from
+    the ISSUE contract are present with plausible values."""
+    p = _write(tmp_path, "m.npz", seed=70)
+    svc = _start_replica(tmp_path, "fl-m")
+    router = _start_router(svc)
+    try:
+        reply = _post_job(router, {"path": p, "shape": [4, 16, 64]},
+                          headers={"X-ICT-Tenant": "grammar"})
+        _await_fleet_terminal(router, [reply["id"]])
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/metrics", timeout=30).read()
+        samples = _parse_prometheus(text.decode())
+        by_name = {}
+        for name, labels, value in samples:
+            by_name.setdefault(name, []).append((labels, value))
+        assert by_name["ict_fleet_placements_total"] == [
+            ('{replica="fl-m"}', "1")]
+        assert ('{tenant="grammar"}', "1") in by_name[
+            "ict_fleet_tenant_admissions_total"]
+        assert ('{state="done"}', "1") in by_name[
+            "ict_fleet_jobs_completed_total"]
+        # per-replica queue-depth gauges, labeled by queue kind
+        depth_labels = {lbl for lbl, _ in
+                        by_name["ict_fleet_replica_queue_depth"]}
+        for queue in ("open_jobs", "load_queue_depth",
+                      "dispatch_queue_depth", "bucketed_cubes"):
+            assert f'{{queue="{queue}",replica="fl-m"}}' in depth_labels
+        assert ('{state="alive"}', "1") in by_name["ict_fleet_replicas"]
+        assert "ict_fleet_open_placements" in by_name
+    finally:
+        router.stop()
+        svc.stop()
+
+
+def test_router_dedupe_of_pinned_key_leaks_no_slot_or_quota(tmp_path):
+    """A client retrying THROUGH the router with its own pinned
+    idempotency key must get the SAME fleet job back — even when the
+    ranking would now pick a DIFFERENT replica (the replica-side map
+    cannot cover that) — and the retry's in-flight slot and tenant-quota
+    count must be handed back, not leaked (each leak would wedge one
+    --max_inflight slot forever)."""
+    p = _write(tmp_path, "pin.npz", seed=85)
+    # fl-pa parks its job (stays loaded), so a second ranking would
+    # prefer the idle fl-pb: exactly the cross-replica duplicate-run case.
+    svc_a = _start_replica(tmp_path, "fl-pa", deadline_s=3600.0,
+                           bucket_cap=8)
+    svc_b = _start_replica(tmp_path, "fl-pb")
+    router = _start_router(svc_a, svc_b, max_inflight=4)
+    try:
+        first = _post_job(router, {"path": p, "idempotency_key": "pin-1"},
+                          headers={"X-ICT-Tenant": "t"})
+        assert first["replica_id"] == "fl-pa"
+        retry = _post_job(router, {"path": p, "idempotency_key": "pin-1"},
+                          headers={"X-ICT-Tenant": "t"})
+        assert retry["id"] == first["id"]
+        assert retry["replica_id"] == "fl-pa"   # not run again on fl-pb
+        assert router.metrics.counter_total("fleet_placements_total") == 1
+        assert router.metrics.counter_total(
+            "fleet_deduped_submissions_total") == 1
+        with router._lock:
+            assert router._inflight == 1
+        assert router.admission.open_count("t") == 1
+        # finish and observe: the one real placement releases cleanly
+        # (wait for the decode to park before draining flushes buckets)
+        deadline = time.time() + 60
+        while (svc_a.scheduler.pending_count() < 1
+               and time.time() < deadline):
+            time.sleep(0.02)
+        svc_a.set_draining(True)
+        assert svc_a.drain(60)
+        _await_fleet_terminal(router, [first["id"]])
+        with router._lock:
+            assert router._inflight == 0
+        assert router.admission.open_count("t") == 0
+    finally:
+        router.stop()
+        svc_a.stop()
+        svc_b.stop()
+
+
+def test_lost_job_404_fails_terminally_instead_of_wedging(tmp_path):
+    """A placement whose replica keeps answering 404 (restarted with a
+    cleared spool inside the death window) must fail terminally after
+    MISSING_POLLS_LOST polls — not leak its slot and quota forever."""
+    from iterative_cleaner_tpu.fleet.router import (
+        MISSING_POLLS_LOST,
+        Placement,
+    )
+
+    svc = _start_replica(tmp_path, "fl-404")
+    router = _start_router(svc, max_inflight=2)
+    try:
+        ghost = Placement(
+            job_id="ghost-1", tenant="t", trace_id="tr", payload={},
+            base_url=f"http://127.0.0.1:{svc.port}", replica_id="fl-404",
+            replica_job_id="0000000000000-deadbeef")
+        router.admission.admit("t")
+        with router._lock:
+            router._placements["ghost-1"] = ghost
+            router._inflight += 1
+        for _ in range(MISSING_POLLS_LOST):
+            router.poll_tick()
+        got = _get(router, "/jobs/ghost-1")
+        assert got["state"] == "error" and "vanished" in got["error"]
+        with router._lock:
+            assert router._inflight == 0
+        assert router.admission.open_count("t") == 0
+    finally:
+        router.stop()
+        svc.stop()
+
+
+def test_replica_idem_map_stays_bounded(tmp_path):
+    """The in-memory idempotency map is capped at spool_keep non-open
+    entries (beyond that a key can only resolve to a pruned manifest),
+    and open jobs never lose their keys — a continuous-traffic replica
+    behind the router (which mints a key per submission) must not grow
+    without bound."""
+    from iterative_cleaner_tpu.service.context import ReplicaContext
+
+    ctx = ReplicaContext(ServeConfig(
+        spool_dir=str(tmp_path / "spool"), spool_keep=3, quiet=True,
+        clean=CleanConfig(backend="numpy")))
+    open_job = ctx.new_job("open.npz", idempotency_key="key-open")
+    assert ctx.admit(open_job, "key-open") is None
+    for i in range(10):
+        job = ctx.new_job(f"j{i}.npz", idempotency_key=f"key-{i}")
+        assert ctx.admit(job, f"key-{i}") is None
+        job.state = "done"
+        ctx.retire(job)
+    with ctx._jobs_lock:
+        idem = dict(ctx._idem)
+    assert len(idem) <= 3 + 1              # cap + the open job's key
+    assert idem["key-open"] == open_job.id  # open keys are never evicted
+    # the newest retired keys survive (time-sortable ids, oldest evicted)
+    assert "key-9" in idem and "key-0" not in idem
+
+
+def test_replica_idempotent_resubmission_dedupes(tmp_path):
+    """The replica-side half of the failover contract: the same
+    idempotency key returns the SAME job — while open, and still after
+    it turned terminal and left the in-memory index (the spool manifest
+    keeps the key deduping)."""
+    p = _write(tmp_path, "i.npz", seed=80)
+    svc = _start_replica(tmp_path, "fl-i")
+    try:
+        def post(key):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{svc.port}/jobs",
+                data=json.dumps({"path": p, "idempotency_key": key}).encode())
+            return json.load(urllib.request.urlopen(req, timeout=30))
+
+        before = tracing.counters_snapshot().get("service_jobs_deduped", 0)
+        first = post("key-1")
+        assert first["idem_key"] == "key-1"
+        assert first["replica_id"] == "fl-i"   # the 202 attribution echo
+        dup = post("key-1")
+        assert dup["id"] == first["id"]
+        fresh = post("key-2")
+        assert fresh["id"] != first["id"]
+        assert svc.drain(60)
+        # terminal + retired from memory: the key still resolves via the
+        # idempotency map -> spool manifest
+        late = post("key-1")
+        assert late["id"] == first["id"] and late["state"] == "done"
+        deduped = tracing.counters_snapshot().get(
+            "service_jobs_deduped", 0) - before
+        assert deduped == 2
+    finally:
+        svc.stop()
+
+
+# --- the jax e2e: affinity + oracle-identical masks on the mesh path ---
+
+
+def test_fleet_jax_replicas_affinity_and_oracle_masks(tmp_path):
+    """3 jax replicas on the virtual 8-device mesh: a warm-declared
+    shape routes to the warm replica (affinity beats the id tie-break),
+    spread covers the others, and every served mask is bit-identical to
+    the numpy oracle through the full router -> replica -> sharded
+    dispatch path."""
+    warm_shape = (4, 16, 64)
+    p_warm = _write(tmp_path, "w.npz", nsub=4, seed=90)
+    p1 = _write(tmp_path, "e1.npz", nsub=8, seed=91)
+    p2 = _write(tmp_path, "e2.npz", nsub=8, seed=92)
+    mesh = make_mesh(8, devices=jax.devices("cpu"))
+    svcs = [
+        _start_replica(tmp_path, "fl-a", backend="jax", mesh=mesh),
+        _start_replica(tmp_path, "fl-b", backend="jax", mesh=mesh),
+        _start_replica(tmp_path, "fl-c", backend="jax", mesh=mesh,
+                       warm_shapes=(warm_shape,)),
+    ]
+    router = _start_router(*svcs)
+    try:
+        # the warm bucket routes to fl-c although fl-a wins every tie-break
+        warm_reply = _post_job(router, {"path": p_warm,
+                                        "shape": list(warm_shape)})
+        assert warm_reply["replica_id"] == "fl-c"
+        r1 = _post_job(router, {"path": p1, "shape": [8, 16, 64]})
+        r2 = _post_job(router, {"path": p2, "shape": [8, 16, 64]})
+        assert {r1["replica_id"], r2["replica_id"]} == {"fl-a", "fl-b"}
+        states = _await_fleet_terminal(
+            router, [warm_reply["id"], r1["id"], r2["id"]], timeout_s=240)
+        for p, reply in ((p_warm, warm_reply), (p1, r1), (p2, r2)):
+            got = states[reply["id"]]
+            assert got["state"] == "done" and got["served_by"] == "sharded"
+            np.testing.assert_array_equal(
+                NpzIO().load(got["out_path"]).weights, _oracle_weights(p))
+    finally:
+        router.stop()
+        for s in svcs:
+            s.stop()
+
+
+def test_fleet_parser_and_cli_dispatch(monkeypatch):
+    from iterative_cleaner_tpu.cli import main
+    from iterative_cleaner_tpu.fleet import router as router_mod
+    from iterative_cleaner_tpu.fleet.router import (
+        build_fleet_parser,
+        fleet_config_from_args,
+        parse_tenant_specs,
+    )
+
+    args = build_fleet_parser().parse_args(
+        ["--replica", "http://h1:8750", "--replica", "http://h2:8750",
+         "--tenant", "survey:64:3", "--tenant", "adhoc:8:1",
+         "--max_inflight", "16"])
+    cfg = fleet_config_from_args(args)
+    assert cfg.replicas == ("http://h1:8750", "http://h2:8750")
+    assert cfg.tenant_quotas == {"survey": 64, "adhoc": 8}
+    assert cfg.tenant_weights == {"survey": 3.0, "adhoc": 1.0}
+    for bad in (["--dead_after", "0"], ["--max_inflight", "-1"], []):
+        with pytest.raises(ValueError):
+            fleet_config_from_args(build_fleet_parser().parse_args(
+                (["--replica", "http://h:1"] if bad else []) + bad))
+    for spec in ("nocolon", "a:b:c", ":1:1", "t:-1:1", "t:1:0"):
+        with pytest.raises(ValueError):
+            parse_tenant_specs([spec])
+    seen = {}
+
+    def fake_fleet(argv):
+        seen["argv"] = argv
+        return 9
+
+    monkeypatch.setattr(router_mod, "fleet_main", fake_fleet)
+    assert main(["serve-fleet", "--port", "0"]) == 9
+    assert seen["argv"] == ["--port", "0"]

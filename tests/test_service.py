@@ -201,16 +201,22 @@ class TestJobSpool:
         assert again.get("nonexistent") is None
 
 
-def test_warm_pool_failed_compile_is_not_reported_warm(monkeypatch):
+def test_warm_pool_failed_compile_is_not_reported_warm(tmp_path, monkeypatch):
     """A failed warm compile must neither skip the remaining batch sizes
     nor leave the shape claiming warmth its executables don't have."""
     from iterative_cleaner_tpu.parallel import sharded
+    from iterative_cleaner_tpu.service.context import ReplicaContext
     from iterative_cleaner_tpu.service.pool import WarmPool
     from iterative_cleaner_tpu.utils import compile_cache
 
     compile_cache._seen.clear()
     mesh = make_mesh(8, devices=jax.devices("cpu"))
-    pool = WarmPool(CleanConfig(backend="jax", max_iter=2), mesh, 4)
+    # The pool is constructed purely from a ReplicaContext (the fleet
+    # refactor): no daemon, no threads — just the per-replica state.
+    ctx = ReplicaContext(ServeConfig(
+        spool_dir=str(tmp_path / "spool"), quiet=True,
+        clean=CleanConfig(backend="jax", max_iter=2)), mesh=mesh)
+    pool = WarmPool(ctx, 4)
     seen_sizes = []
 
     def flaky(Db, w0b, cfg, mesh):

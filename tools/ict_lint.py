@@ -5,7 +5,7 @@ Layers (docs/ANALYSIS.md):
 
 - ``--source``     AST lint rules (ICT000-ICT006) over the package,
                    tools/, bench.py — offline, no jax import;
-- ``--races``      the service//obs/ static race detector
+- ``--races``      the service//obs//fleet/ static race detector
                    (ICT007 guarded-by, ICT008 lock-order) — offline;
 - ``--contracts``  the jaxpr/HLO route contract checker (ICT009) —
                    imports jax, pins the CPU backend first;
@@ -46,7 +46,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--source", action="store_true",
                    help="AST source rules (ICT000-ICT006)")
     p.add_argument("--races", action="store_true",
-                   help="service//obs/ race detector (ICT007, ICT008)")
+                   help="service//obs//fleet/ race detector (ICT007, ICT008)")
     p.add_argument("--contracts", action="store_true",
                    help="jaxpr/HLO route contracts (ICT009; imports jax, "
                         "pins JAX_PLATFORMS=cpu unless ICT_TEST_TPU=1)")
