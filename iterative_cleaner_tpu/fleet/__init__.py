@@ -16,6 +16,12 @@ package puts a router process in front of N daemon replicas:
                      re-routing with idempotency keys (a job never runs
                      twice on one replica), its own Prometheus /metrics,
                      and the ``serve-fleet`` CLI (+ ``--smoke``)
+- :mod:`.obs`      — the fleet observability plane: /metrics federation
+                     (per-replica re-labeling + exact merged families on
+                     ``GET /fleet/metrics``), cross-hop trace assembly
+                     (``GET /fleet/trace/<id>``), incident bundles under
+                     ``<spool>/fleet-incidents/``, and SLO/straggler
+                     detection feeding placement de-prioritization
 
 The router is routing, not math: every mask is produced by a replica,
 and replicas stay bit-identical to the numpy oracle on every route

@@ -70,10 +70,39 @@ class ReplicaClient:
             raise ReplicaUnreachable(f"{url}: non-object JSON reply")
         return payload
 
+    def _call_text(self, url: str) -> str:
+        """GET one non-JSON endpoint (the replica's Prometheus
+        ``/metrics``); same transport-vs-HTTP error split as JSON calls."""
+        try:
+            with urllib.request.urlopen(
+                    urllib.request.Request(url),
+                    timeout=self.timeout_s) as resp:
+                return resp.read().decode("utf-8")
+        except urllib.error.HTTPError as exc:
+            raise ReplicaRefused(exc.code, {"error": exc.reason}) from exc
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError) as exc:
+            raise ReplicaUnreachable(f"{url}: {exc}") from exc
+
     # --- the replica surface the router speaks ---
 
     def health(self, base_url: str) -> dict:
         return self._call(f"{base_url}/healthz")
+
+    def metrics_text(self, base_url: str) -> str:
+        """The replica's raw Prometheus exposition — the federation
+        scrape (fleet/obs.py parses it strictly)."""
+        return self._call_text(f"{base_url}/metrics")
+
+    def job_trace(self, base_url: str, job_id: str) -> dict:
+        """GET /jobs/<id>/trace: the replica's persisted per-job
+        forensics timeline — the lazy half of cross-hop trace assembly."""
+        return self._call(f"{base_url}/jobs/{job_id}/trace")
+
+    def flight(self, base_url: str) -> dict:
+        """GET /debug/flight: the replica's live flight ring — cached by
+        the poll loop as the best-effort pre-death record."""
+        return self._call(f"{base_url}/debug/flight")
 
     def submit(self, base_url: str, payload: dict,
                trace_id: str = "") -> dict:

@@ -195,4 +195,11 @@ class ReplicaRegistry:
                     r.health.get("bucket_queue_depths", {})),
                 "warm_shapes": list(r.health.get("warm_shapes", [])),
                 "backend": r.health.get("backend", ""),
+                "version": r.health.get("version", ""),
+                # Correctness-health passthrough: the router's incident
+                # watch keys audit-divergence/demotion bundles off these
+                # (fleet/obs.py), and /healthz readers gate on them the
+                # same way they gate on a single replica's.
+                "audits_run": r.health.get("audits_run", 0),
+                "audit_divergences": r.health.get("audit_divergences", 0),
             } for r in self._replicas.values()]

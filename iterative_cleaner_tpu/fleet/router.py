@@ -40,13 +40,16 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import threading
 import time
 import uuid
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from iterative_cleaner_tpu.fleet import obs as fleet_obs
 from iterative_cleaner_tpu.fleet.client import (
     ReplicaClient,
     ReplicaRefused,
@@ -59,8 +62,8 @@ from iterative_cleaner_tpu.fleet.tenants import (
     TenantAdmission,
     WeightedFairQueue,
 )
-from iterative_cleaner_tpu.obs import events
-from iterative_cleaner_tpu.obs.metrics import _fmt, _labels
+from iterative_cleaner_tpu.obs import events, flight
+from iterative_cleaner_tpu.obs import metrics as obs_metrics
 from iterative_cleaner_tpu.service.scheduler import bucket_label
 from iterative_cleaner_tpu.utils import backoff
 
@@ -72,6 +75,12 @@ from iterative_cleaner_tpu.utils import backoff
 #: loses to an idle cold one.
 AFFINITY_WARM = 2.5
 AFFINITY_QUEUED = 1.25
+
+#: Placement-score PENALTY for a replica the straggler detector has
+#: flagged (fleet/obs.py): bigger than both affinity bonuses combined, so
+#: a slow-but-warm replica still loses to a healthy cold one, yet finite
+#: — a fleet whose every survivor is flagged still places.
+STRAGGLER_PENALTY = 4.0
 
 #: Consecutive 404 status polls before an open placement is declared
 #: lost (its replica restarted with a cleared spool and genuinely does
@@ -106,6 +115,18 @@ class FleetConfig:
     default_quota: int = 0           # per-tenant open-placement cap (0 = off)
     default_weight: float = 1.0
     telemetry: str = ""              # JSON-lines event log (obs/events)
+    spool_dir: str = "./ict_fleet_spool"   # router-side durable dir:
+                                     # flight-ring dumps (<spool>/flight)
+                                     # and incident bundles
+                                     # (<spool>/fleet-incidents)
+    straggler_factor: float = 3.0    # p50 multiple of the fleet median
+                                     # that flags a replica (fleet/obs.py)
+    straggler_polls: int = 3         # consecutive slow polls before firing
+    straggler_window: int = 8        # polls of latency deltas per p50
+    straggler_phase: str = "service_dispatch"  # the watched phase family
+    slo_grant_s: float = 1.0         # per-tenant SLO on the WFQ grant
+                                     # wait; beyond it (or a grant
+                                     # timeout) burns fleet_slo_burn_total
     quiet: bool = False
 
 
@@ -130,6 +151,11 @@ class Placement:
     error: str = ""
     attempts: int = 1               # placements incl. failover re-routes
     submitted_s: float = 0.0
+    # Every (replica, replica_job_id) this placement has lived on, in
+    # placement order — the cross-hop trace assembly walks these to
+    # stitch a failed-over job's telemetry from BOTH replicas
+    # (fleet/obs.py; mutated only under the router's placement lock).
+    hops: list = field(default_factory=list)
     missing_polls: int = 0          # consecutive status polls the serving
                                     # replica answered 404 — a revived
                                     # replica whose spool was cleared has
@@ -193,21 +219,14 @@ class RouterMetrics:
                 self._gauges[(family, tuple(sorted(labels)))] = float(value)
 
     def render(self) -> str:
-        """Prometheus text exposition; same grammar obs/metrics.py renders
-        (pinned by the strict-regex test in tests/test_fleet.py)."""
+        """Prometheus text exposition via the ONE shared renderer in
+        obs/metrics.py (render_registries) — the registry is deliberately
+        separate from the process-global one, the grammar implementation
+        is not (pinned by the strict-regex test in tests/test_fleet.py)."""
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
-        lines: list[str] = []
-        for kind, table in (("counter", counters), ("gauge", gauges)):
-            seen: set[str] = set()
-            for (family, label_pairs) in sorted(table):
-                if family not in seen:
-                    seen.add(family)
-                    lines.append(f"# TYPE ict_{family} {kind}")
-                lines.append(f"ict_{family}{_labels(label_pairs)} "
-                             f"{_fmt(table[(family, label_pairs)])}")
-        return "\n".join(lines) + "\n"
+        return obs_metrics.render_registries(counters, gauges)
 
 
 class _Ticket:
@@ -252,6 +271,22 @@ class FleetRouter:
         self._cond = threading.Condition(self._lock)
         self._wfq = WeightedFairQueue(
             weights=cfg.tenant_weights, default_weight=cfg.default_weight)
+        # The fleet observability plane (fleet/obs.py): per-replica
+        # /metrics + flight-ring scrape cache, the bounded cross-hop span
+        # store, and the windowed straggler detector — each owns its own
+        # lock, always acquired AFTER the router's (never while holding
+        # theirs), so the router -> registry/metrics order extends to
+        # router -> obs cleanly.
+        self.scrapes = fleet_obs.ScrapeCache()
+        self.traces = fleet_obs.TraceStore()
+        self.straggler = fleet_obs.StragglerDetector(
+            factor=cfg.straggler_factor, polls=cfg.straggler_polls,
+            window=cfg.straggler_window)
+        # Last observed (audit_divergences, backend) per replica: the
+        # incident watch fires a bundle when divergences move or a
+        # replica demotes jax -> numpy between polls.
+        self._health_seen: dict[str, tuple[float, str]] = {}  # ict: guarded-by(self._lock)
+        self._last_poll_mono = 0.0  # monotonic stamp of the last completed poll_tick  # ict: guarded-by(self._lock)
         self._placements: dict[str, Placement] = {}  # ict: guarded-by(self._lock)
         # idempotency key -> fleet job id ("" while a placement carrying
         # the key is in flight): the ROUTER-side half of the dedupe — a
@@ -270,12 +305,22 @@ class FleetRouter:
         self._server = None
         self.port = cfg.port
 
+    @property
+    def flight_dir(self) -> str:
+        return os.path.join(self.cfg.spool_dir, "flight")
+
+    @property
+    def incident_dir(self) -> str:
+        return os.path.join(self.cfg.spool_dir, "fleet-incidents")
+
     # --- lifecycle ---
 
     def start(self) -> None:
         # Same contract as the daemon: telemetry="" must MEAN "honor
         # ICT_TELEMETRY / disabled", never inherit a predecessor's sink.
         events.configure(self.cfg.telemetry or None)
+        flight.note("router_starting", router_id=self.router_id,
+                    replicas=len(self.cfg.replicas))
         # Synchronous first poll: replica identities and load snapshots
         # exist before the first placement decision.
         self.registry.poll_once(self.client)
@@ -314,7 +359,16 @@ class FleetRouter:
 
     def _poll_loop(self) -> None:
         while not self._stop_evt.wait(self.cfg.poll_interval_s):
-            self.poll_tick()
+            try:
+                self.poll_tick()
+            except Exception as exc:  # noqa: BLE001 — the fleet control
+                # loop (death detection, failover, grant pump) must
+                # outlive any one tick's surprise; poll_tick itself stays
+                # raising so tests and the smoke see errors loudly.
+                self.metrics.count("fleet_poll_errors_total")
+                if not self.cfg.quiet:
+                    print(f"ict-fleet: poll tick failed ({exc!r}); "
+                          "continuing", file=sys.stderr)
 
     def poll_tick(self) -> None:
         """One maintenance pass; public so tests (and the smoke check)
@@ -326,13 +380,155 @@ class FleetRouter:
                       f"is dead after {rep.consecutive_failures} failed "
                       "health checks; re-routing its open placements",
                       file=sys.stderr)
+            # Death eviction takes its flight ring and metrics to the
+            # grave — except for what the scrape cache already holds:
+            # snapshot it into an incident bundle NOW.
+            self._note_incident("replica_death",
+                                replica_id=rep.replica_id or rep.base_url)
+        self._scrape_replicas()
+        self._watch_replica_health()
         self._refresh_open_placements()
         self._failover_sweep()
         self._update_replica_gauges()
         self._trim_placements()
+        with self._lock:
+            self._last_poll_mono = time.monotonic()
         # Replica capacity may have freed (placements turned terminal) —
         # wake any submissions parked in the WFQ grant wait.
         self._grant_free_slots()
+
+    def _scrape_replicas(self) -> None:
+        """Metrics federation's inbound half: pull every live replica's
+        ``/metrics`` (strict-parsed) and ``/debug/flight`` (the
+        best-effort pre-death cache) into the scrape cache, feed the
+        straggler detector, and rebuild the staleness/straggler gauges.
+        Scrapes run CONCURRENTLY (the registry poll_once discipline): one
+        wedged replica costs the tick one timeout, not one per healthy
+        replica behind it."""
+        # Every ALIVE replica is scraped — a draining one still serves
+        # accepted work and its latency belongs in the fleet view.
+        rows = [r for r in self.registry.snapshot() if r["alive"]]
+
+        def scrape(row: dict):
+            rid = row["replica_id"] or row["base_url"]
+            try:
+                text = self.client.metrics_text(row["base_url"])
+                families = obs_metrics.parse_exposition(text)
+            except (ReplicaUnreachable, ReplicaRefused, ValueError):
+                # Liveness is the health poll's job; a failed scrape just
+                # marks the cached copy stale (visible on the age gauge).
+                return rid, None, None, None
+            try:
+                ring = self.client.flight(row["base_url"])
+                flight_events = list(ring.get("events", []))
+            except (ReplicaUnreachable, ReplicaRefused):
+                flight_events = None   # keep the previous cached ring
+            return rid, text, families, flight_events
+
+        if rows:
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(rows)),
+                    thread_name_prefix="ict-fleet-scrape") as pool:
+                results = list(pool.map(scrape, rows))
+        else:
+            results = []
+        for rid, text, families, flight_events in results:
+            if families is None:
+                self.scrapes.note_failure(rid)
+            else:
+                self.scrapes.update(rid, text, families, flight_events)
+        snap = self.scrapes.snapshot()
+        cum = {rid: fleet_obs.phase_hist_cum(rec["families"],
+                                             self.cfg.straggler_phase)
+               for rid, rec in snap.items() if rec["ok"]}
+        verdict = self.straggler.update(cum)
+        for rid in verdict["fired"]:
+            self.metrics.count("fleet_straggler_flags_total",
+                               {"replica": rid})
+            if events.active():
+                events.emit("fleet_straggler", replica_id=rid,
+                            p50_s=verdict["p50"].get(rid),
+                            fleet_median_s=verdict["median"])
+            if not self.cfg.quiet:
+                print(f"ict-fleet: replica {rid} flagged as a straggler "
+                      f"(p50 {verdict['p50'].get(rid)}s vs fleet median "
+                      f"{verdict['median']}s); de-prioritizing placements",
+                      file=sys.stderr)
+        for rid in verdict["cleared"]:
+            if events.active():
+                events.emit("fleet_straggler_cleared", replica_id=rid)
+            if not self.cfg.quiet:
+                print(f"ict-fleet: replica {rid} recovered; straggler "
+                      "flag cleared", file=sys.stderr)
+        ages = self.scrapes.ages()
+        self.metrics.replace_gauge_family(
+            "fleet_scrape_ok",
+            {(("replica", rid),): (1.0 if rec["ok"] else 0.0)
+             for rid, rec in snap.items()})
+        self.metrics.replace_gauge_family(
+            "fleet_scrape_age_seconds",
+            {(("replica", rid),): age for rid, age in ages.items()})
+        # A flagged replica whose scrape just failed stays on the gauge
+        # (the detector keeps its flag) — union, not just this tick's cum.
+        self.metrics.replace_gauge_family(
+            "fleet_stragglers",
+            {(("replica", rid),): (1.0 if rid in verdict["stragglers"]
+                                   else 0.0)
+             for rid in set(cum) | verdict["stragglers"]})
+        self.metrics.replace_gauge_family(
+            "fleet_replica_p50_seconds",
+            {(("replica", rid),): p for rid, p in verdict["p50"].items()})
+
+    def _watch_replica_health(self) -> None:
+        """Fire an incident bundle when a replica's correctness health
+        moves between polls: audit divergences counted up, or the backend
+        demoted jax -> numpy (the worker ladder's top rung)."""
+        for row in self.registry.snapshot():
+            rid = row["replica_id"] or row["base_url"]
+            if not row["alive"]:
+                continue
+            div = float(row.get("audit_divergences", 0) or 0)
+            backend = str(row.get("backend", "") or "")
+            with self._lock:
+                prev = self._health_seen.get(rid)
+                self._health_seen[rid] = (div, backend)
+            if prev is None:
+                continue
+            if div > prev[0]:
+                self._note_incident("audit_divergence", replica_id=rid)
+            if prev[1] == "jax" and backend == "numpy":
+                self._note_incident("backend_demotion", replica_id=rid)
+
+    def _note_incident(self, reason: str, replica_id: str = "",
+                       job_id: str = "", trace_id: str = "") -> str | None:
+        """Snapshot the fleet's state into one incident bundle
+        (fleet/obs.py): placement table, registry, the replica's last
+        good scrape + cached flight ring, and — for job-scoped incidents
+        — the stitched trace."""
+        scrape = self.scrapes.snapshot().get(replica_id, {})
+        trace = None
+        if trace_id:
+            code, payload = self.fleet_trace(trace_id)
+            if code == 200:
+                trace = payload
+        with self._lock:
+            placements = [{
+                "job_id": p.job_id, "tenant": p.tenant,
+                "trace_id": p.trace_id, "state": p.state,
+                "replica_id": p.replica_id, "attempts": p.attempts,
+            } for p in self._placements.values()]
+        path = fleet_obs.write_incident_bundle(
+            self.incident_dir, reason=reason, replica_id=replica_id,
+            job_id=job_id, trace_id=trace_id, placements=placements,
+            replicas=self.registry.snapshot(),
+            metrics_text=scrape.get("text", ""),
+            flight_events=scrape.get("flight"), trace=trace)
+        self.metrics.count("fleet_incidents_total", {"reason": reason})
+        if events.active():
+            events.emit("fleet_incident", trace_id=trace_id, reason=reason,
+                        replica_id=replica_id, job_id=job_id,
+                        bundle=path or "")
+        return path
 
     def _refresh_open_placements(self) -> None:
         with self._lock:
@@ -371,9 +567,16 @@ class FleetRouter:
             except ReplicaUnreachable:
                 unreachable_now.add(p.base_url)
                 dead = self.registry.note_unreachable(p.base_url)
-                if dead is not None and not self.cfg.quiet:
-                    print(f"ict-fleet: replica {dead.replica_id} died "
-                          "mid-status-poll", file=sys.stderr)
+                if dead is not None:
+                    if not self.cfg.quiet:
+                        print(f"ict-fleet: replica {dead.replica_id} died "
+                              "mid-status-poll", file=sys.stderr)
+                    # Every alive->dead flip writes its incident bundle,
+                    # whichever path observed it (poll_tick covers the
+                    # health-poll flips).
+                    self._note_incident(
+                        "replica_death",
+                        replica_id=dead.replica_id or dead.base_url)
                 continue
             with self._lock:
                 p.missing_polls = 0
@@ -410,8 +613,16 @@ class FleetRouter:
                 p.replica_id = new_rep.replica_id
                 p.replica_job_id = str(body.get("id", p.replica_job_id))
                 p.attempts += 1
+                p.hops.append({"replica_id": new_rep.replica_id,
+                               "base_url": new_rep.base_url,
+                               "replica_job_id": p.replica_job_id,
+                               "ts": round(time.time(), 6)})
             self.metrics.count("fleet_failovers_total",
                                {"from_replica": from_id})
+            self.traces.record(p.trace_id, "fleet_failover",
+                               job_id=p.job_id, from_replica=from_id,
+                               to_replica=new_rep.replica_id,
+                               attempts=p.attempts)
             if events.active():
                 events.emit("fleet_failover", trace_id=p.trace_id,
                             job_id=p.job_id, from_replica=from_id,
@@ -420,6 +631,10 @@ class FleetRouter:
             if not self.cfg.quiet:
                 print(f"ict-fleet: job {p.job_id} re-routed "
                       f"{from_id} -> {new_rep.replica_id}", file=sys.stderr)
+            # The failover incident carries the stitched trace — the
+            # dead hop's spans come from the pre-death flight cache.
+            self._note_incident("failover", replica_id=from_id,
+                                job_id=p.job_id, trace_id=p.trace_id)
 
     def _update_replica_gauges(self) -> None:
         snap = self.registry.snapshot()
@@ -548,6 +763,10 @@ class FleetRouter:
             base_url=rep.base_url, replica_id=rep.replica_id,
             replica_job_id=str(body.get("id", "")),
             submitted_s=time.time())
+        placement.hops.append({"replica_id": rep.replica_id,
+                               "base_url": rep.base_url,
+                               "replica_job_id": placement.replica_job_id,
+                               "ts": round(time.time(), 6)})
         with self._lock:
             existing = self._placements.get(placement.job_id)
             duplicate = existing is not None and existing.state == "open"
@@ -566,6 +785,12 @@ class FleetRouter:
             return {**body, "tenant": tenant, "router_id": self.router_id}
         self.metrics.count("fleet_placements_total",
                            {"replica": rep.replica_id or rep.base_url})
+        self.traces.record(trace_id, "fleet_submit", job_id=placement.job_id,
+                           tenant=tenant)
+        self.traces.record(trace_id, "fleet_placement",
+                           job_id=placement.job_id,
+                           replica_id=rep.replica_id, tenant=tenant,
+                           bucket=self._bucket_of(payload))
         if events.active():
             events.emit("fleet_placement", trace_id=trace_id,
                         job_id=placement.job_id,
@@ -576,22 +801,32 @@ class FleetRouter:
     def _await_grant(self, tenant: str) -> None:
         """Weighted-fair wait for an in-flight slot.  With no budget
         configured the grant is immediate; under contention, grants pop
-        in WFQ order as slots free (placements observed terminal)."""
+        in WFQ order as slots free (placements observed terminal).  A
+        grant wait beyond the per-tenant SLO target (``slo_grant_s``) —
+        or a timeout — burns ``fleet_slo_burn_total{tenant}``, the
+        admission-path half of the SLO layer (fleet/obs.py)."""
         ticket = _Ticket()
-        deadline = time.monotonic() + self.cfg.queue_timeout_s
-        with self._lock:
-            self._wfq.push(tenant, ticket)
-            self._grant_free_slots()
-            while not ticket.granted:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0 or self._stop_evt.is_set():
-                    ticket.abandoned = True
-                    raise FleetBusy(
-                        f"no placement slot within "
-                        f"{self.cfg.queue_timeout_s:g}s "
-                        f"({self._inflight} in flight at the "
-                        f"--max_inflight budget); retry later")
-                self._cond.wait(remaining)
+        t0 = time.monotonic()
+        deadline = t0 + self.cfg.queue_timeout_s
+        try:
+            with self._lock:
+                self._wfq.push(tenant, ticket)
+                self._grant_free_slots()
+                while not ticket.granted:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or self._stop_evt.is_set():
+                        ticket.abandoned = True
+                        raise FleetBusy(
+                            f"no placement slot within "
+                            f"{self.cfg.queue_timeout_s:g}s "
+                            f"({self._inflight} in flight at the "
+                            f"--max_inflight budget); retry later")
+                    self._cond.wait(remaining)
+        except FleetBusy:
+            self.metrics.count("fleet_slo_burn_total", {"tenant": tenant})
+            raise
+        if time.monotonic() - t0 > self.cfg.slo_grant_s:
+            self.metrics.count("fleet_slo_burn_total", {"tenant": tenant})
 
     def _grant_free_slots(self) -> None:
         """Pop WFQ tickets into free in-flight slots and wake their
@@ -629,6 +864,7 @@ class FleetRouter:
                            exclude: set[str]) -> list[Replica]:
         cands = [r for r in self.registry.candidates()
                  if r.base_url not in exclude]
+        flagged = self.straggler.stragglers()
 
         def score(rep: Replica) -> float:
             s = rep.load()
@@ -637,6 +873,10 @@ class FleetRouter:
                     s -= AFFINITY_WARM
                 if rep.queued_buckets().get(bucket, 0) > 0:
                     s -= AFFINITY_QUEUED
+            # A flagged straggler is de-prioritized, never excluded: a
+            # fleet whose every survivor is slow must still place.
+            if rep.replica_id in flagged:
+                s += STRAGGLER_PENALTY
             return s
 
         # Deterministic tie-break on replica identity, so tests (and two
@@ -667,7 +907,11 @@ class FleetRouter:
                                               trace_id=trace_id)
                 except ReplicaUnreachable as exc:
                     last_err = exc
-                    self.registry.note_unreachable(rep.base_url)
+                    dead = self.registry.note_unreachable(rep.base_url)
+                    if dead is not None:
+                        self._note_incident(
+                            "replica_death",
+                            replica_id=dead.replica_id or dead.base_url)
                     continue
                 except ReplicaRefused as exc:
                     if exc.status == 503:   # at capacity, or draining
@@ -693,7 +937,11 @@ class FleetRouter:
             except ReplicaRefused as exc:
                 return exc.status, exc.body
             except ReplicaUnreachable:
-                self.registry.note_unreachable(p.base_url)
+                dead = self.registry.note_unreachable(p.base_url)
+                if dead is not None:
+                    self._note_incident(
+                        "replica_death",
+                        replica_id=dead.replica_id or dead.base_url)
                 manifest = None
             if manifest is not None:
                 self._observe_manifest(p, manifest)
@@ -720,7 +968,11 @@ class FleetRouter:
             except ReplicaRefused:
                 pass
             except ReplicaUnreachable:
-                self.registry.note_unreachable(p.base_url)
+                dead = self.registry.note_unreachable(p.base_url)
+                if dead is not None:
+                    self._note_incident(
+                        "replica_death",
+                        replica_id=dead.replica_id or dead.base_url)
         return 200, {"id": p.job_id, "state": p.state,
                      "error": p.error or None,
                      "replica_id": p.replica_id, "tenant": p.tenant,
@@ -745,21 +997,120 @@ class FleetRouter:
             self._grant_free_slots()
         self.admission.release(p.tenant)
         self.metrics.count("fleet_jobs_completed_total", {"state": state})
+        self.traces.record(p.trace_id, f"fleet_{state}", job_id=p.job_id,
+                           replica_id=p.replica_id,
+                           **({"error": error} if error else {}))
+
+    def fleet_metrics(self) -> str:
+        """``GET /fleet/metrics``: the router's own exposition, then every
+        cached replica scrape re-labeled ``{replica=...}``, then the
+        merged fleet families — all three sections from consistent
+        snapshots, so the merged totals equal the per-replica sums they
+        sit next to (fleet/obs.py)."""
+        snap = self.scrapes.snapshot()
+        scrapes = {rid: rec["families"] for rid, rec in snap.items()
+                   if rec.get("families")}
+        return self.metrics.render() + fleet_obs.federated_exposition(scrapes)
+
+    def fleet_trace(self, trace_id: str) -> tuple[int, dict]:
+        """``GET /fleet/trace/<id>``: one stitched cross-hop timeline.
+
+        Router spans (submit/placement/failover/terminal) interleave with
+        each hop's replica-side spans: a live hop's come from its
+        persisted ``GET /jobs/<id>/trace``; a dead hop's from the
+        pre-death flight-ring cache (filtered to this trace id)."""
+        router_spans = self.traces.spans(trace_id)
+        job_id = self.traces.job_for(trace_id)
+        with self._lock:
+            p = self._placements.get(job_id) if job_id else None
+            if p is None:
+                # The span store may have evicted an old trace the
+                # placement table still remembers (or vice versa).
+                p = next((q for q in self._placements.values()
+                          if q.trace_id == trace_id), None)
+            if p is not None:
+                job_id = p.job_id
+                state = p.state
+                hops = [dict(h) for h in p.hops]
+            else:
+                state, hops = "", []
+        if not router_spans and p is None:
+            return 404, {"error": f"no trace {trace_id!r} in the span "
+                                  "store or the placement table"}
+        sources: dict[str, str] = {}
+        hop_spans: dict[str, list[dict]] = {}
+        for hop in hops:
+            rid = hop["replica_id"] or hop["base_url"]
+            rep = self.registry.get(hop["base_url"])
+            if rep is not None and rep.alive:
+                try:
+                    tr = self.client.job_trace(hop["base_url"],
+                                               hop["replica_job_id"])
+                except (ReplicaUnreachable, ReplicaRefused):
+                    pass
+                else:
+                    spans = [{"source": rid, "event": "replica_job",
+                              "state": tr.get("state"),
+                              "served_by": tr.get("served_by"),
+                              "loops": tr.get("loops"),
+                              "termination": tr.get("termination")}]
+                    spans += [{"source": rid, "event": "iteration", **rec}
+                              for rec in tr.get("timeline", [])]
+                    hop_spans[rid] = spans
+                    sources[rid] = "live"
+            if rid not in hop_spans:
+                # The dead-hop path: whatever of this trace the poll
+                # loop's flight-ring cache caught before the replica died.
+                cached = [{"source": rid, **rec}
+                          for rec in self.scrapes.flight_events(rid)
+                          if rec.get("trace_id") == trace_id]
+                if cached:
+                    hop_spans[rid] = cached
+                    sources[rid] = "flight-cache"
+                else:
+                    hop_spans[rid] = [{"source": rid,
+                                       "event": "replica_trace_unavailable"}]
+                    sources[rid] = "unavailable"
+        stitched: list[dict] = []
+        for span in sorted(router_spans, key=lambda s: s.get("ts", 0.0)):
+            stitched.append(span)
+            rid = span.get("to_replica") or span.get("replica_id") or ""
+            if (span.get("event") in ("fleet_placement", "fleet_failover")
+                    and rid in hop_spans):
+                stitched.extend(hop_spans.pop(rid))
+        for leftovers in hop_spans.values():
+            stitched.extend(leftovers)
+        return 200, {"trace_id": trace_id, "job_id": job_id,
+                     "state": state, "hops": hops, "sources": sources,
+                     "spans": stitched}
 
     def health(self) -> dict:
+        from iterative_cleaner_tpu import __version__
+
         snap = self.registry.snapshot()
+        ages = self.scrapes.ages()
+        for row in snap:
+            # Per-replica scrape staleness on the router's own health
+            # contract (the satellite parity with replica /healthz).
+            row["scrape_age_s"] = ages.get(
+                row["replica_id"] or row["base_url"])
         with self._lock:
             open_n = sum(1 for p in self._placements.values()
                          if p.state == "open")
             queued = len(self._wfq)
             inflight = self._inflight
+            last_poll = self._last_poll_mono
         return {
             "status": "ok",
             "router_id": self.router_id,
+            "version": __version__,
             "uptime_s": round(time.time() - self.started_s, 3),
+            "last_poll_age_s": (round(time.monotonic() - last_poll, 3)
+                                if last_poll else None),
             "replicas": snap,
             "replicas_alive": sum(1 for r in snap
                                   if r["alive"] and not r["draining"]),
+            "stragglers": sorted(self.straggler.stragglers()),
             "open_placements": open_n,
             "queued_submissions": queued,
             "inflight": inflight,
@@ -819,11 +1170,25 @@ class _RouterHandler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             body = router.metrics.render().encode()
             self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Type", obs_metrics.CONTENT_TYPE)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path == "/fleet/metrics":
+            body = router.fleet_metrics().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", obs_metrics.CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path.startswith("/fleet/trace/"):
+            tid = self.path[len("/fleet/trace/"):]
+            code, payload = router.fleet_trace(tid)
+            self._reply(code, payload)
+        elif self.path == "/fleet/incidents":
+            self._reply(200, {
+                "directory": router.incident_dir,
+                "incidents": fleet_obs.list_incidents(router.incident_dir)})
         elif self.path == "/replicas":
             self._reply(200, {"replicas": router.registry.snapshot()})
         elif self.path.startswith("/jobs/"):
@@ -947,6 +1312,30 @@ def build_fleet_parser() -> argparse.ArgumentParser:
     p.add_argument("--telemetry", default="", metavar="PATH",
                    help="append fleet_placement/fleet_failover events to "
                         "PATH as JSON lines (ICT_TELEMETRY equivalent)")
+    p.add_argument("--spool", default="./ict_fleet_spool", metavar="DIR",
+                   help="router-side durable directory: flight-ring dumps "
+                        "on SIGTERM/SIGINT (DIR/flight) and incident "
+                        "bundles on death eviction / failover / "
+                        "audit-divergence demotion (DIR/fleet-incidents; "
+                        "default ./ict_fleet_spool)")
+    p.add_argument("--straggler_factor", type=float, default=3.0,
+                   metavar="F",
+                   help="flag a replica whose latency p50 exceeds F times "
+                        "the fleet median (default 3.0; must be > 1)")
+    p.add_argument("--straggler_polls", type=int, default=3, metavar="K",
+                   help="consecutive slow polls before the straggler flag "
+                        "fires (default 3)")
+    p.add_argument("--straggler_window", type=int, default=8, metavar="N",
+                   help="polls of latency-histogram deltas in each p50 "
+                        "window (default 8)")
+    p.add_argument("--straggler_phase", default="service_dispatch",
+                   metavar="PHASE",
+                   help="the scraped latency-histogram phase the straggler "
+                        "p50s watch (default service_dispatch)")
+    p.add_argument("--slo_grant_s", type=float, default=1.0, metavar="S",
+                   help="per-tenant SLO on the placement-grant wait; a "
+                        "longer wait (or a grant timeout) burns "
+                        "fleet_slo_burn_total{tenant} (default 1.0)")
     p.add_argument("-q", "--quiet", action="store_true")
     p.add_argument("--smoke", action="store_true",
                    help="offline self-check: 2 in-process replicas behind "
@@ -984,6 +1373,16 @@ def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
     if args.max_inflight < 0:
         raise ValueError(f"--max_inflight must be >= 0 (0 = unbounded), "
                          f"got {args.max_inflight}")
+    if args.straggler_factor <= 1:
+        raise ValueError(f"--straggler_factor must be > 1 (a replica AT "
+                         f"the median is not a straggler), got "
+                         f"{args.straggler_factor}")
+    if args.straggler_polls < 1:
+        raise ValueError(f"--straggler_polls must be >= 1, got "
+                         f"{args.straggler_polls}")
+    if args.straggler_window < 1:
+        raise ValueError(f"--straggler_window must be >= 1, got "
+                         f"{args.straggler_window}")
     quotas, weights = parse_tenant_specs(args.tenant)
     return FleetConfig(
         replicas=tuple(args.replica),
@@ -1001,8 +1400,46 @@ def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
         default_quota=args.default_quota,
         default_weight=args.default_weight,
         telemetry=args.telemetry,
+        spool_dir=args.spool,
+        straggler_factor=args.straggler_factor,
+        straggler_polls=args.straggler_polls,
+        straggler_window=args.straggler_window,
+        straggler_phase=args.straggler_phase,
+        slo_grant_s=args.slo_grant_s,
         quiet=args.quiet,
     )
+
+
+def _merged_counters_equal(families) -> bool:
+    """Check one parsed /fleet/metrics exposition's federation invariant:
+    every merged counter total equals the sum of the per-replica series
+    it was built from (summed in sorted-replica order, the same order the
+    merge used, so float totals match bit-for-bit).  Shared by the smoke
+    and the e2e tests."""
+    merged: dict[tuple, float] = {}
+    per_replica: dict[tuple, list] = {}
+    for fam in families:
+        if fam.kind != "counter":
+            continue
+        for name, labels, raw in fam.samples:
+            value = obs_metrics.sample_value(raw)
+            d = dict(labels)
+            if fam.name.startswith("ict_fleet_"):
+                merged[(name, labels)] = value
+            elif "replica" in d:
+                rid = d.pop("replica")
+                key = (fleet_obs.merged_name(name),
+                       tuple(p for p in labels if p[0] != "replica"))
+                per_replica.setdefault(key, []).append((rid, value))
+    if not per_replica:
+        return False
+    for key, entries in per_replica.items():
+        total = 0.0
+        for _rid, value in sorted(entries):
+            total += value
+        if merged.get(key) != total:
+            return False
+    return True
 
 
 def run_fleet_smoke(cfg: FleetConfig) -> int:
@@ -1010,9 +1447,14 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
     several jobs submitted THROUGH the router; the replica holding a
     parked (undispatched) job is killed; every job must complete exactly
     once with masks bit-identical to the numpy oracle and the shadow
-    audit clean; at least one failover must be recorded.  One JSON line,
-    rc 0/1 — the CI lane next to ``serve --smoke``."""
-    import os
+    audit clean; at least one failover must be recorded.  The fleet
+    observability plane is asserted end to end on top: the merged
+    ``GET /fleet/metrics`` scrape passes the strict exposition grammar
+    with merged counters exactly equal to the per-replica sums and a
+    nonzero ``fleet_jobs_completed``, the induced failover yields a
+    stitched ``GET /fleet/trace`` spanning both replicas, and at least
+    one incident bundle lands on disk.  One JSON line, rc 0/1 — the CI
+    lane next to ``serve --smoke``."""
     import tempfile
     import urllib.request
 
@@ -1071,6 +1513,9 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
             "port": 0,
             "poll_interval_s": poll_s,
             "dead_after": dead_after,
+            # Hermetic: incident bundles and flight dumps land in the
+            # smoke's own tempdir, never the operator's spool.
+            "spool_dir": os.path.join(tmp, "router_spool"),
         }))
         router.start()
         jobs = {}
@@ -1096,6 +1541,11 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                 if health.get("bucketed_cubes", 0) >= len(placed_on_a) > 0:
                     break
                 time.sleep(0.05)
+            # One deterministic scrape pass BEFORE the crash: the dead
+            # replica's pre-death metrics + flight ring must be in the
+            # router's cache for the incident bundle and the stitched
+            # trace (the poll loop would usually have done this already).
+            router.poll_tick()
             svc_a.stop()    # the "crash": parked jobs stay in its spool
             # Router polls mark a dead and re-route; wait for every job
             # (under its fleet id) to turn terminal through the router.
@@ -1129,8 +1579,37 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                     if not np.array_equal(got.weights, want):
                         masks_ok = False
             failovers = router.metrics.counter_total("fleet_failovers_total")
+            # --- the fleet observability plane, end to end ---
+            # Merged /fleet/metrics: strict grammar (the parse IS the
+            # check), merged counters exactly the sum of the per-replica
+            # series next to them, and the completion counter moved.
+            fleet_text = urllib.request.urlopen(
+                f"{base}/fleet/metrics", timeout=10).read().decode()
+            fleet_ok = False
+            try:
+                fams = obs_metrics.parse_exposition(fleet_text)
+            except ValueError:
+                fams = []
+            if fams:
+                fleet_ok = (_merged_counters_equal(fams)
+                            and router.metrics.counter_value(
+                                "fleet_jobs_completed_total",
+                                {"state": "done"}) == len(paths))
+            # Stitched cross-hop trace: a failed-over job's timeline must
+            # carry spans from BOTH replicas under its one trace id.
+            trace_ok = False
+            for j in jobs.values():
+                trace = json.load(urllib.request.urlopen(
+                    f"{base}/fleet/trace/{j['trace_id']}", timeout=10))
+                span_sources = {s.get("source") for s in trace["spans"]}
+                if {"smoke-a", "smoke-b"} <= span_sources:
+                    trace_ok = True
+                    break
+            incidents = json.load(urllib.request.urlopen(
+                f"{base}/fleet/incidents", timeout=10))["incidents"]
             ok = (all_done and masks_ok and failovers >= 1
                   and done_delta == len(paths)
+                  and fleet_ok and trace_ok and len(incidents) >= 1
                   and health_b.get("audits_run", 0) >= 1
                   and health_b.get("audit_divergences", 0) == 0)
             result = {
@@ -1141,6 +1620,9 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                 "completions": int(done_delta),
                 "failovers": int(failovers),
                 "mask_identical_to_oracle": bool(masks_ok),
+                "fleet_metrics_merged_ok": bool(fleet_ok),
+                "stitched_trace_ok": bool(trace_ok),
+                "incident_bundles": len(incidents),
                 "audits_run": health_b.get("audits_run", 0),
                 "audit_divergences": health_b.get("audit_divergences", 0),
                 "placements": {
@@ -1170,13 +1652,20 @@ def fleet_main(argv: list[str] | None = None) -> int:
     except (RuntimeError, OSError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1
+    # SIGTERM/SIGINT dump the router's flight ring before the graceful
+    # stop — the same handler shape as serve_main: "what was the router
+    # doing when the orchestrator killed it" becomes a file under
+    # <spool>/flight instead of a guess (docs/OBSERVABILITY.md "Fleet
+    # observability").
     import signal
 
     def _on_stop_signal(signum, frame):
         name = signal.Signals(signum).name
+        path = flight.dump(name, router.flight_dir)
         print(f"ict-fleet: {name} — shutting down (replicas keep their "
               "accepted work; placements resume on restart via replica "
-              "spools)", file=sys.stderr)
+              f"spools{'; flight ring at ' + path if path else ''})",
+              file=sys.stderr)
         raise SystemExit(0)
 
     for sig in (signal.SIGTERM, signal.SIGINT):
@@ -1188,7 +1677,12 @@ def fleet_main(argv: list[str] | None = None) -> int:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
-        pass
+        # Reached only when the SIGINT handler could not be installed (a
+        # non-main-thread embed): same graceful stop, same flight dump.
+        path = flight.dump("KeyboardInterrupt", router.flight_dir)
+        print("ict-fleet: shutting down"
+              f"{' (flight ring at ' + path + ')' if path else ''}",
+              file=sys.stderr)
     finally:
         router.stop()
     return 0

@@ -25,9 +25,23 @@ snapshot moved to ``/metrics.json``).  Three metric classes:
   ``ict_executable_bytes_accessed{shape_bucket=...}``) from
   tracing.set_gauge / set_gauge_labeled / max_gauge_labeled — the
   memory/cost accounting of obs/memory.py.
+
+This module also owns the *strict text-format parser* for the same
+exposition (:func:`parse_exposition` / :class:`MetricFamily` /
+:func:`render_exposition`): the fleet router's metrics federation
+(fleet/obs.py) parses every replica scrape with it, and the round-trip is
+exact — ``render_exposition(parse_exposition(text)) == text`` for
+anything this module (or the router's registry renderer) produced — so
+the parser, the renderer, and the grammar tests can never drift apart.
+:func:`render_registries` is the one shared renderer for plain
+``{(family, label_pairs) -> value}`` counter/gauge registries (the fleet
+router's ``RouterMetrics.render`` delegates here).
 """
 
 from __future__ import annotations
+
+import dataclasses
+import re
 
 from iterative_cleaner_tpu.obs import tracing
 
@@ -112,3 +126,166 @@ def render_prometheus() -> str:
         lines.append(f"ict_{family}{_labels(label_pairs)} {_fmt(value)}")
 
     return "\n".join(lines) + "\n"
+
+
+def render_registries(counters: dict, gauges: dict,
+                      prefix: str = "ict_") -> str:
+    """Render plain ``{(family, ((label, value), ...)) -> float}`` counter
+    and gauge registries as Prometheus text — the ONE implementation of
+    the flat-registry exposition, shared by the fleet router's
+    ``RouterMetrics`` (its registry is deliberately separate from the
+    process-global one, but its *grammar* must not be a second
+    implementation)."""
+    lines: list[str] = []
+    for kind, table in (("counter", counters), ("gauge", gauges)):
+        seen: set[str] = set()
+        for (family, label_pairs) in sorted(table):
+            if family not in seen:
+                seen.add(family)
+                lines.append(f"# TYPE {prefix}{family} {kind}")
+            lines.append(f"{prefix}{family}{_labels(label_pairs)} "
+                         f"{_fmt(table[(family, label_pairs)])}")
+    # Empty registries render as the empty exposition, not a lone "\n" —
+    # a freshly started router's first scrape must still parse strictly.
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+# --- the strict text-format parser (the federation's inbound half) ---
+
+#: Metric/sample name and label-key grammars (the Prometheus data model);
+#: values are the exposition's number grammar plus the +/-Inf / NaN
+#: specials the renderer can emit via ``repr(float)``.
+_NAME_RE = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_HELP_RE = re.compile(rf"^# HELP ({_NAME_RE}) (.+)$")
+_TYPE_RE = re.compile(
+    rf"^# TYPE ({_NAME_RE}) (counter|gauge|histogram|summary|untyped)$")
+_SAMPLE_RE = re.compile(
+    rf"^({_NAME_RE})(?:\{{(.*)\}})? "
+    r"(-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|[+-]?Inf|NaN)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+#: Histogram sample-name suffixes (`<family>_bucket` / `_sum` / `_count`).
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+@dataclasses.dataclass
+class MetricFamily:
+    """One parsed exposition family: the ``# TYPE`` header (``kind`` is
+    None for samples that appeared without one), the optional ``# HELP``
+    text, and the samples in file order — each ``(sample_name,
+    label_pairs, raw_value)`` with the value kept as the exact source
+    string so re-rendering round-trips byte-for-byte."""
+
+    name: str
+    kind: str | None = None
+    help: str | None = None
+    samples: list = dataclasses.field(default_factory=list)
+
+
+def _unescape(value: str) -> str:
+    """Inverse of :func:`_escape` (label-value backslash escapes)."""
+    out: list[str] = []
+    i = 0
+    while i < len(value):
+        if value[i] == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            if nxt in ("\\", '"'):
+                out.append(nxt)
+                i += 2
+                continue
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+        out.append(value[i])
+        i += 1
+    return "".join(out)
+
+
+def _parse_label_pairs(raw: str) -> tuple:
+    """Parse the inside of ``{...}`` strictly; raises ValueError on any
+    residue the label grammar does not cover."""
+    pairs: list[tuple[str, str]] = []
+    pos = 0
+    while pos < len(raw):
+        m = _LABEL_RE.match(raw, pos)
+        if m is None:
+            raise ValueError(f"bad label syntax at {raw[pos:]!r}")
+        pairs.append((m.group(1), _unescape(m.group(2))))
+        pos = m.end()
+        if pos < len(raw):
+            if raw[pos] != ",":
+                raise ValueError(f"bad label separator at {raw[pos:]!r}")
+            pos += 1
+    return tuple(pairs)
+
+
+def _sample_family(name: str, current: MetricFamily | None) -> bool:
+    """Whether a sample named ``name`` belongs to ``current`` (exact name,
+    or a histogram-suffixed one for histogram families)."""
+    if current is None:
+        return False
+    if name == current.name:
+        return True
+    return (current.kind == "histogram"
+            and any(name == current.name + sfx for sfx in _HIST_SUFFIXES))
+
+
+def parse_exposition(text: str) -> list[MetricFamily]:
+    """Parse Prometheus text exposition strictly into families.
+
+    Raises ValueError on any line outside the grammar — the parse IS the
+    grammar check the fleet smoke and the federation tests rely on.
+    Samples with no preceding ``# TYPE`` become kind-None families (the
+    renderer then emits no TYPE line, preserving the round-trip)."""
+    families: list[MetricFamily] = []
+    pending_help: tuple[str, str] | None = None
+    current: MetricFamily | None = None
+    for line in text.splitlines():
+        if not line:
+            continue   # the format permits blank lines; none are emitted
+        m = _HELP_RE.match(line)
+        if m is not None:
+            pending_help = (m.group(1), m.group(2))
+            continue
+        m = _TYPE_RE.match(line)
+        if m is not None:
+            current = MetricFamily(name=m.group(1), kind=m.group(2))
+            if pending_help is not None and pending_help[0] == current.name:
+                current.help = pending_help[1]
+            pending_help = None
+            families.append(current)
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"bad exposition line: {line!r}")
+        name, raw_labels, raw_value = m.group(1), m.group(2), m.group(3)
+        labels = _parse_label_pairs(raw_labels) if raw_labels else ()
+        if not _sample_family(name, current):
+            current = MetricFamily(name=name, kind=None)
+            families.append(current)
+        current.samples.append((name, labels, raw_value))
+    return families
+
+
+def render_exposition(families: list[MetricFamily]) -> str:
+    """Inverse of :func:`parse_exposition`: HELP line (when recorded),
+    TYPE line (when typed), samples with raw values verbatim."""
+    lines: list[str] = []
+    for fam in families:
+        if fam.help is not None:
+            lines.append(f"# HELP {fam.name} {fam.help}")
+        if fam.kind is not None:
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+        for name, labels, raw_value in fam.samples:
+            lines.append(f"{name}{_labels(labels)} {raw_value}")
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+def sample_value(raw: str) -> float:
+    """Numeric value of a raw sample string (``+Inf``/``NaN`` included)."""
+    if raw in ("+Inf", "Inf"):
+        return float("inf")
+    if raw == "-Inf":
+        return float("-inf")
+    return float(raw)

@@ -165,7 +165,11 @@ class _Handler(BaseHTTPRequestHandler):
                                   if job is None else
                                   f"no such route {self.path!r}"})
             elif sep:
-                self._reply(200, job.trace_dict())
+                # replica_id rides on the trace the same way it rides on
+                # the 202: the fleet router's cross-hop trace assembly
+                # labels each stitched span with its source replica.
+                self._reply(200, {**job.trace_dict(),
+                                  "replica_id": service.replica_id})
             else:
                 self._reply(200, job.to_dict())
         elif self.path == "/debug/profiles":

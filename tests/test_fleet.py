@@ -15,6 +15,7 @@ death detection and failover sweeps are deterministic instead of slept-for.
 from __future__ import annotations
 
 import json
+import tempfile
 import time
 import urllib.error
 import urllib.request
@@ -72,7 +73,9 @@ def _start_router(*svcs, **kw):
     defaults = dict(
         replicas=tuple(f"http://127.0.0.1:{s.port}" for s in svcs),
         port=0, poll_interval_s=999.0, dead_after=2, quiet=True,
-        retry_backoff_s=0.01, queue_timeout_s=5.0)
+        retry_backoff_s=0.01, queue_timeout_s=5.0,
+        # Hermetic: incident bundles / flight dumps never land in cwd.
+        spool_dir=tempfile.mkdtemp(prefix="ict_fleet_router_"))
     defaults.update(kw)
     router = FleetRouter(FleetConfig(**defaults))
     router.start()

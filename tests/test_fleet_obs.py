@@ -1,0 +1,594 @@
+"""ict-fleet-obs: the fleet observability plane (ISSUE 10).
+
+Units: the strict exposition parser round-trips the renderers exactly,
+counter/histogram merging preserves sums and bucket monotonicity, the
+gauge merge policy splits max/sum families, the straggler detector fires
+after K slow polls and clears on recovery, the span store and incident
+retention stay bounded.  End to end: ``GET /fleet/metrics`` passes the
+strict grammar with merged totals equal to the per-replica sums, a
+kill-mid-queue failover yields one stitched ``GET /fleet/trace``
+spanning both replicas plus incident bundles on disk, masks stay
+bit-identical to the oracle with the whole plane enabled, and the
+router's SIGTERM handler dumps its flight ring (the serve_main parity
+satellite).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from test_fleet import (
+    _await_fleet_terminal,
+    _FakeClient,
+    _get,
+    _oracle_weights,
+    _post_job,
+    _start_replica,
+    _start_router,
+    _write,
+)
+from test_observability import _parse_prometheus
+from iterative_cleaner_tpu.fleet import obs as fleet_obs
+from iterative_cleaner_tpu.fleet.obs import (
+    MAX_INCIDENTS_KEPT,
+    MetricFamily,
+    ScrapeCache,
+    StragglerDetector,
+    TraceStore,
+)
+from iterative_cleaner_tpu.fleet.router import (
+    FleetConfig,
+    FleetRouter,
+    RouterMetrics,
+    _merged_counters_equal,
+)
+from iterative_cleaner_tpu.io.npz import NpzIO
+from iterative_cleaner_tpu.obs import metrics as obs_metrics
+from iterative_cleaner_tpu.obs import tracing
+
+
+# --- the parser: strict grammar, exact round-trip ---
+
+
+def test_exposition_parser_round_trips_process_renderer_exactly():
+    """parse(render_prometheus()) re-renders byte-for-byte: the parser,
+    the renderer, and the grammar can never drift apart."""
+    tracing.observe_phase("t_fobs_phase", 0.003)
+    tracing.observe_phase("t_fobs_phase", 1.7)
+    tracing.count("t_fobs_counter", 3)
+    tracing.count_labeled("t_fobs_total", {"route": "unit"}, 2)
+    tracing.set_gauge("t_fobs_gauge", 1.5)
+    tracing.set_gauge_labeled("t_fobs_lgauge", {"device": "cpu:0"}, 7)
+    text = obs_metrics.render_prometheus()
+    families = obs_metrics.parse_exposition(text)
+    assert obs_metrics.render_exposition(families) == text
+    # and the strict line regex the repo's grammar tests use agrees
+    _parse_prometheus(text)
+
+
+def test_exposition_parser_round_trips_router_renderer_exactly():
+    m = RouterMetrics()
+    m.count("fleet_placements_total", {"replica": "r-a"})
+    m.count("fleet_placements_total", {"replica": "r-b"}, 2)
+    m.count("fleet_deduped_submissions_total")
+    m.set_gauge("fleet_open_placements", None, 3)
+    # label-value escaping (backslash, newline) survives the round trip
+    m.count("fleet_tenant_admissions_total", {"tenant": "we\\ird\nten ant"})
+    text = m.render()
+    families = obs_metrics.parse_exposition(text)
+    assert obs_metrics.render_exposition(families) == text
+    _parse_prometheus(text)
+    # the escaped label value parses back to its original form
+    samples = [s for fam in families for s in fam.samples
+               if fam.name == "ict_fleet_tenant_admissions_total"]
+    assert dict(samples[0][1])["tenant"] == "we\\ird\nten ant"
+    # escaped quotes round-trip through parse/render too (the repo's
+    # line regex predates them, so only the parser pair is asserted)
+    q = RouterMetrics()
+    q.count("fleet_tenant_admissions_total", {"tenant": 'quo"ted'})
+    qtext = q.render()
+    qfams = obs_metrics.parse_exposition(qtext)
+    assert obs_metrics.render_exposition(qfams) == qtext
+    assert dict(qfams[0].samples[0][1])["tenant"] == 'quo"ted'
+
+
+def test_exposition_parser_rejects_bad_grammar():
+    for bad in (
+        "not a metric line at all !\n",
+        "ok_name{unclosed=\"x\" 1\n",
+        "ok_name{bad-key=\"x\"} 1\n",
+        "ok_name 1.2.3\n",
+        "ok_name -+Inf\n",               # a sign may not prefix the specials
+        "ok_name --Inf\n",
+        "# TYPE ict_x bogus_kind\n",
+    ):
+        with pytest.raises(ValueError):
+            obs_metrics.parse_exposition(bad)
+
+
+def test_empty_registries_render_empty_and_parse():
+    """A freshly started router has no samples yet: the render must be
+    the EMPTY exposition (parseable), never a lone newline the strict
+    grammar rejects."""
+    assert RouterMetrics().render() == ""
+    assert obs_metrics.parse_exposition("") == []
+    assert obs_metrics.parse_exposition("\n") == []   # blank lines allowed
+
+
+def test_phase_hist_cum_skips_foreign_le_labels():
+    """A grammar-valid scrape whose `le` label is not a number must be
+    skipped, not raise out of the poll thread that extracts buckets."""
+    fam = MetricFamily(name="ict_phase_duration_seconds", kind="histogram")
+    fam.samples.append(("ict_phase_duration_seconds_bucket",
+                        (("phase", "service_dispatch"), ("le", "weird")),
+                        "3"))
+    fam.samples.append(("ict_phase_duration_seconds_bucket",
+                        (("phase", "service_dispatch"), ("le", "+Inf")),
+                        "3"))
+    cum = fleet_obs.phase_hist_cum([fam], "service_dispatch")
+    assert cum == {float("inf"): 3.0}
+
+
+# --- merging: sums, monotonicity, gauge policy ---
+
+
+def _synth_scrapes(seed: int, n_replicas: int = 3):
+    rng = random.Random(seed)
+    bounds = [0.001, 0.01, 0.1, 1.0]
+    scrapes = {}
+    for i in range(n_replicas):
+        counters = MetricFamily(name="ict_jobs_total", kind="counter")
+        counters.samples.append(
+            ("ict_jobs_total", (("route", "sharded"),),
+             str(rng.randint(0, 100))))
+        counters.samples.append(
+            ("ict_jobs_total", (("route", "oracle"),),
+             str(rng.randint(0, 100))))
+        hist = MetricFamily(name="ict_phase_duration_seconds",
+                            kind="histogram")
+        cum = 0
+        for le in bounds:
+            cum += rng.randint(0, 20)
+            hist.samples.append((
+                "ict_phase_duration_seconds_bucket",
+                (("phase", "service_dispatch"), ("le", repr(le))),
+                str(cum)))
+        cum += rng.randint(0, 20)
+        hist.samples.append(("ict_phase_duration_seconds_bucket",
+                             (("phase", "service_dispatch"), ("le", "+Inf")),
+                             str(cum)))
+        hist.samples.append(("ict_phase_duration_seconds_sum",
+                             (("phase", "service_dispatch"),),
+                             repr(rng.random() * 10)))
+        hist.samples.append(("ict_phase_duration_seconds_count",
+                             (("phase", "service_dispatch"),), str(cum)))
+        rss = MetricFamily(name="ict_host_rss_bytes", kind="gauge")
+        rss.samples.append(("ict_host_rss_bytes", (),
+                            str(rng.randint(10**6, 10**8))))
+        peak = MetricFamily(name="ict_route_hbm_peak_bytes", kind="gauge")
+        peak.samples.append(("ict_route_hbm_peak_bytes",
+                             (("route", "sharded"),),
+                             str(rng.randint(10**6, 10**8))))
+        scrapes[f"rep-{i}"] = [counters, hist, rss, peak]
+    return scrapes
+
+
+@pytest.mark.parametrize("seed", [7, 21, 1999])
+def test_merged_counters_equal_per_replica_sums(seed):
+    scrapes = _synth_scrapes(seed)
+    merged = {f.name: f for f in fleet_obs.merge_families(scrapes)}
+    for route in ("sharded", "oracle"):
+        want = sum(
+            obs_metrics.sample_value(raw)
+            for fams in scrapes.values() for fam in fams
+            if fam.name == "ict_jobs_total"
+            for name, labels, raw in fam.samples
+            if dict(labels)["route"] == route)
+        got = [obs_metrics.sample_value(raw)
+               for name, labels, raw in merged["ict_fleet_jobs_total"].samples
+               if dict(labels)["route"] == route]
+        assert got == [want]
+
+
+@pytest.mark.parametrize("seed", [3, 1234])
+def test_merged_histogram_buckets_stay_monotone_and_exact(seed):
+    scrapes = _synth_scrapes(seed)
+    merged = {f.name: f for f in fleet_obs.merge_families(scrapes)}
+    fam = merged["ict_fleet_phase_duration_seconds"]
+    assert fam.kind == "histogram"
+    buckets = [(obs_metrics.sample_value(dict(labels)["le"]),
+                obs_metrics.sample_value(raw))
+               for name, labels, raw in fam.samples
+               if name.endswith("_bucket")]
+    ordered = [n for _le, n in sorted(buckets)]
+    assert ordered == sorted(ordered), "merged buckets must stay cumulative"
+    # bucket-wise exactness: each merged bucket is the per-replica sum
+    for le, n in buckets:
+        want = sum(
+            obs_metrics.sample_value(raw)
+            for fams in scrapes.values() for f in fams
+            if f.name == "ict_phase_duration_seconds"
+            for name, labels, raw in f.samples
+            if name.endswith("_bucket")
+            and obs_metrics.sample_value(dict(labels)["le"]) == le)
+        assert n == want
+    # _count merges additively too
+    count = [obs_metrics.sample_value(raw) for name, _l, raw in fam.samples
+             if name.endswith("_count")]
+    assert count == [sum(
+        obs_metrics.sample_value(raw)
+        for fams in scrapes.values() for f in fams
+        if f.name == "ict_phase_duration_seconds"
+        for name, _l2, raw in f.samples if name.endswith("_count"))]
+
+
+def test_gauge_merge_policy_splits_max_and_sum():
+    assert fleet_obs.gauge_merge_policy("ict_host_rss_bytes") == "sum"
+    assert fleet_obs.gauge_merge_policy("ict_route_hbm_peak_bytes") == "max"
+    assert fleet_obs.gauge_merge_policy("ict_service_load_max_s") == "max"
+    assert fleet_obs.gauge_merge_policy(
+        "ict_audit_last_divergence_ts") == "max"
+    assert fleet_obs.gauge_merge_policy("ict_hbm_bytes_limit") == "max"
+    scrapes = _synth_scrapes(99)
+    merged = {f.name: f for f in fleet_obs.merge_families(scrapes)}
+    peaks = [obs_metrics.sample_value(raw)
+             for fams in scrapes.values() for f in fams
+             if f.name == "ict_route_hbm_peak_bytes"
+             for _n, _l, raw in f.samples]
+    rss = [obs_metrics.sample_value(raw)
+           for fams in scrapes.values() for f in fams
+           if f.name == "ict_host_rss_bytes"
+           for _n, _l, raw in f.samples]
+    assert [obs_metrics.sample_value(r) for _n, _l, r in
+            merged["ict_fleet_route_hbm_peak_bytes"].samples] == [max(peaks)]
+    assert [obs_metrics.sample_value(r) for _n, _l, r in
+            merged["ict_fleet_host_rss_bytes"].samples] == [sum(rss)]
+
+
+def test_federated_exposition_is_valid_and_self_consistent():
+    scrapes = _synth_scrapes(5)
+    text = fleet_obs.federated_exposition(scrapes)
+    _parse_prometheus(text)
+    families = obs_metrics.parse_exposition(text)
+    assert _merged_counters_equal(families)
+    # per-replica series carry the replica label
+    labeled = [dict(labels).get("replica")
+               for fam in families if fam.name == "ict_jobs_total"
+               for _n, labels, _v in fam.samples]
+    assert sorted(set(labeled)) == ["rep-0", "rep-1", "rep-2"]
+
+
+# --- straggler detection ---
+
+
+def _cum(fast: float, slow: float, n_fast: int, n_slow: int):
+    """Cumulative bucket counts with n_fast obs at <=fast and n_slow at
+    <=slow (fast < slow)."""
+    inf = float("inf")
+    return {fast: float(n_fast), slow: float(n_fast + n_slow),
+            inf: float(n_fast + n_slow)}
+
+
+def test_straggler_fires_after_k_polls_and_clears_on_recovery():
+    det = StragglerDetector(factor=3.0, polls=2, window=2, min_count=1)
+    fast = lambda n: _cum(0.01, 1.0, n, 0)          # noqa: E731
+    slow = lambda n: _cum(0.01, 1.0, 0, n)          # noqa: E731
+    # poll 1: replica c is slow — consecutive count starts, no flag yet
+    v = det.update({"a": fast(5), "b": fast(5), "c": slow(5)})
+    assert v["fired"] == [] and v["stragglers"] == set()
+    assert v["p50"]["c"] == 1.0 and v["p50"]["a"] == 0.01
+    # poll 2: still slow — fires
+    v = det.update({"a": fast(10), "b": fast(10), "c": slow(10)})
+    assert v["fired"] == ["c"] and v["stragglers"] == {"c"}
+    assert det.stragglers() == {"c"}
+    # recovery: fast polls roll the slow deltas out of the window — the
+    # flag clears as soon as the windowed p50 re-enters bounds
+    v3 = det.update({"a": fast(15), "b": fast(15),
+                     "c": {0.01: 5.0, 1.0: 15.0, float("inf"): 15.0}})
+    v4 = det.update({"a": fast(20), "b": fast(20),
+                     "c": {0.01: 10.0, 1.0: 20.0, float("inf"): 20.0}})
+    assert "c" in v3["cleared"] + v4["cleared"]
+    assert det.stragglers() == set()
+
+
+def test_straggler_keeps_flag_when_scrape_fails():
+    """A flagged replica MISSING from an update (its scrape failed)
+    keeps the flag and emits no cleared event — a degrading replica
+    must not shed its placement penalty by timing out its own scrape."""
+    det = StragglerDetector(factor=3.0, polls=1, window=2, min_count=1)
+    fast = lambda n: _cum(0.01, 1.0, n, 0)          # noqa: E731
+    slow = lambda n: _cum(0.01, 1.0, 0, n)          # noqa: E731
+    v = det.update({"a": fast(5), "b": fast(5), "c": slow(5)})
+    assert v["stragglers"] == {"c"}
+    # c's scrape fails: it is absent from the next update
+    v = det.update({"a": fast(10), "b": fast(10)})
+    assert v["cleared"] == []
+    assert det.stragglers() == {"c"}
+
+
+def test_straggler_needs_min_count_and_two_replicas():
+    det = StragglerDetector(factor=2.0, polls=1, window=4, min_count=5)
+    # below min_count: no p50, no verdict
+    v = det.update({"a": _cum(0.01, 1.0, 2, 0), "b": _cum(0.01, 1.0, 0, 2)})
+    assert v["p50"] == {} and v["stragglers"] == set()
+    # one replica only: no fleet median to compare against
+    det2 = StragglerDetector(factor=2.0, polls=1, min_count=1)
+    v = det2.update({"solo": _cum(0.01, 1.0, 0, 50)})
+    assert v["median"] is None and v["stragglers"] == set()
+
+
+def test_straggler_penalty_deprioritizes_placement():
+    """A flagged replica drops to the bottom of the ranked candidates at
+    equal load (the de-prioritization half of the SLO layer)."""
+    router = FleetRouter(FleetConfig(replicas=("http://a", "http://b",
+                                               "http://c"),
+                                     straggler_polls=1))
+    ok = {"open_jobs": 0}
+    router.registry.poll_once(_FakeClient({
+        "http://a": dict(ok, replica_id="ra"),
+        "http://b": dict(ok, replica_id="rb"),
+        "http://c": dict(ok, replica_id="rc")}))
+    ranked = [r.replica_id for r in router._ranked_candidates("", set())]
+    assert ranked == ["ra", "rb", "rc"]       # plain id tie-break
+    # flag ra via the real detector path (polls=1: one slow poll fires)
+    v = router.straggler.update({
+        "ra": _cum(0.01, 1.0, 0, 5),
+        "rb": _cum(0.01, 1.0, 5, 0),
+        "rc": _cum(0.01, 1.0, 5, 0)})
+    assert v["stragglers"] == {"ra"}
+    ranked = [r.replica_id for r in router._ranked_candidates("", set())]
+    assert ranked == ["rb", "rc", "ra"]       # penalized to the back
+
+
+# --- span store + incident bundle bounds ---
+
+
+def test_trace_store_is_bounded_lru():
+    store = TraceStore(max_traces=3, max_spans=2)
+    for i in range(5):
+        store.record(f"tr-{i}", "fleet_submit", job_id=f"j-{i}")
+    assert store.spans("tr-0") == [] and store.spans("tr-1") == []
+    assert store.job_for("tr-4") == "j-4"
+    for _ in range(5):
+        store.record("tr-4", "fleet_noise")
+    assert len(store.spans("tr-4")) == 2      # span cap holds
+    # recording touches recency: tr-4 survives two newer traces
+    store.record("tr-5", "fleet_submit")
+    store.record("tr-6", "fleet_submit")
+    assert store.spans("tr-4")
+
+
+def test_incident_bundles_atomic_and_retained(tmp_path):
+    d = str(tmp_path / "incidents")
+    paths = []
+    for i in range(MAX_INCIDENTS_KEPT + 3):
+        p = fleet_obs.write_incident_bundle(
+            d, reason=f"r{i}", replica_id="rep-x", job_id=f"j{i}",
+            metrics_text="ict_x 1\n", flight_events=[{"event": "e"}],
+            trace={"spans": []})
+        assert p is not None
+        paths.append(p)
+        time.sleep(0.002)   # distinct ms timestamps keep names sortable
+    names = sorted(os.listdir(d))
+    assert len(names) == MAX_INCIDENTS_KEPT
+    assert not any(n.endswith(".part") for n in names)
+    # newest survive, oldest swept
+    assert os.path.basename(paths[-1]) in names
+    assert os.path.basename(paths[0]) not in names
+    listed = fleet_obs.list_incidents(d)
+    assert len(listed) == MAX_INCIDENTS_KEPT
+    assert listed[-1]["reason"] == f"r{MAX_INCIDENTS_KEPT + 2}"
+    bundle = paths[-1]
+    assert sorted(os.listdir(bundle)) == [
+        "flight.json", "manifest.json", "metrics.prom", "trace.json"]
+
+
+def test_scrape_cache_keeps_last_good_and_reports_age():
+    cache = ScrapeCache()
+    cache.update("r1", "ict_x 1\n", [], [{"event": "e1"}])
+    cache.note_failure("r1")
+    snap = cache.snapshot()
+    assert snap["r1"]["ok"] is False
+    assert snap["r1"]["text"] == "ict_x 1\n"   # last good copy kept
+    assert cache.ages()["r1"] >= 0
+    # a scrape that could not fetch the flight ring keeps the old cache
+    cache.update("r1", "ict_x 2\n", [], None)
+    assert cache.flight_events("r1") == [{"event": "e1"}]
+
+
+# --- end to end: federation, stitched failover trace, incidents ---
+
+
+def test_fleet_metrics_federation_e2e(tmp_path):
+    """3 replicas: /fleet/metrics passes the strict grammar, carries
+    per-replica re-labeled series + staleness gauges for all three, and
+    its merged counters exactly equal the per-replica sums beside them;
+    the router /healthz gains the observability fields."""
+    paths = [_write(tmp_path, f"fm{i}.npz", seed=110 + i) for i in range(3)]
+    svcs = [_start_replica(tmp_path, f"fo-{t}") for t in "abc"]
+    router = _start_router(*svcs)
+    try:
+        replies = [_post_job(router, {"path": p}) for p in paths]
+        states = _await_fleet_terminal(router, [r["id"] for r in replies])
+        assert all(s["state"] == "done" for s in states.values())
+        # one tick AFTER the last completion: the scrape cache now
+        # definitely holds post-completion counters (the await loop's
+        # final tick may have scraped just before the jobs finished)
+        router.poll_tick()
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/fleet/metrics",
+            timeout=30).read().decode()
+        _parse_prometheus(text)                     # strict grammar
+        families = obs_metrics.parse_exposition(text)
+        assert _merged_counters_equal(families)
+        by_name = {f.name: f for f in families}
+        # per-replica series for all three replicas
+        jobs_done = by_name["ict_service_jobs_done"]
+        replicas = {dict(labels)["replica"]
+                    for _n, labels, _v in jobs_done.samples}
+        assert replicas == {"fo-a", "fo-b", "fo-c"}
+        # the merged rename sits next to them
+        assert "ict_fleet_service_jobs_done" in by_name
+        # staleness gauges: every replica scraped and fresh
+        ok = {dict(labels)["replica"]: obs_metrics.sample_value(raw)
+              for _n, labels, raw in by_name["ict_fleet_scrape_ok"].samples}
+        assert ok == {"fo-a": 1.0, "fo-b": 1.0, "fo-c": 1.0}
+        assert "ict_fleet_scrape_age_seconds" in by_name
+        # router /healthz: version, poll age, per-replica scrape ages
+        health = _get(router, "/healthz")
+        assert health["version"]
+        assert health["last_poll_age_s"] is not None
+        assert all(r["scrape_age_s"] is not None
+                   for r in health["replicas"])
+        assert health["stragglers"] == []
+    finally:
+        router.stop()
+        for s in svcs:
+            s.stop()
+
+
+def test_failover_stitched_trace_and_incidents_e2e(tmp_path):
+    """The tentpole failure story, observability edition: a replica dies
+    with parked jobs; after failover the stitched /fleet/trace carries
+    spans from BOTH replicas under one trace id (the dead hop served
+    from the pre-death flight cache), incident bundles for the death and
+    the failover land on disk (inventory endpoint agrees), and the
+    served masks stay bit-identical to the oracle with the full plane
+    enabled."""
+    paths = [_write(tmp_path, f"ft{i}.npz", seed=130 + i) for i in range(3)]
+    svc_a = _start_replica(tmp_path, "fo-a", deadline_s=3600.0, bucket_cap=8)
+    svc_b = _start_replica(tmp_path, "fo-b")
+    router = _start_router(svc_a, svc_b)
+    try:
+        replies = [_post_job(router, {"path": p}) for p in paths]
+        on_a = [r for r in replies if r["replica_id"] == "fo-a"]
+        assert on_a
+        deadline = time.time() + 60
+        while (svc_a.scheduler.pending_count() < len(on_a)
+               and time.time() < deadline):
+            time.sleep(0.02)
+        # one tick while fo-a is alive: its metrics + flight ring (with
+        # this trace's job_submitted events) enter the pre-death cache
+        router.poll_tick()
+        svc_a.stop()
+        router.poll_tick()
+        router.poll_tick()
+        states = _await_fleet_terminal(router, [r["id"] for r in replies])
+        assert all(s["state"] == "done" for s in states.values())
+        for p, r in zip(paths, replies):
+            np.testing.assert_array_equal(
+                NpzIO().load(states[r["id"]]["out_path"]).weights,
+                _oracle_weights(p))
+        # stitched trace for a failed-over job
+        reply = on_a[0]
+        trace = _get(router, f"/fleet/trace/{reply['trace_id']}")
+        assert trace["trace_id"] == reply["trace_id"]
+        assert trace["job_id"] == reply["id"]
+        events_seen = [s["event"] for s in trace["spans"]
+                       if s["source"] == "router"]
+        assert events_seen[0] == "fleet_submit"
+        for needed in ("fleet_placement", "fleet_failover", "fleet_done"):
+            assert needed in events_seen
+        sources = {s["source"] for s in trace["spans"]}
+        assert {"fo-a", "fo-b"} <= sources
+        # the dead hop came from the flight cache, the live one fetched
+        assert trace["sources"]["fo-b"] == "live"
+        assert trace["sources"]["fo-a"] in ("flight-cache", "unavailable")
+        assert [h["replica_id"] for h in trace["hops"]] == ["fo-a", "fo-b"]
+        # an unknown trace id is a 404, not an empty stitch
+        assert _get(router, "/fleet/trace/feedfacedeadbeef",
+                    expect_error=True) == 404
+        # incident bundles: the death and each failover, listed + on disk
+        inv = _get(router, "/fleet/incidents")
+        reasons = [i["reason"] for i in inv["incidents"]]
+        assert "replica_death" in reasons and "failover" in reasons
+        failover_bundle = next(i for i in inv["incidents"]
+                               if i["reason"] == "failover")
+        assert os.path.isfile(os.path.join(failover_bundle["path"],
+                                           "trace.json"))
+        assert os.path.isfile(os.path.join(failover_bundle["path"],
+                                           "manifest.json"))
+        assert router.metrics.counter_total("fleet_incidents_total") == len(
+            inv["incidents"])
+    finally:
+        router.stop()
+        svc_b.stop()
+
+
+def test_slo_burn_counters_on_the_grant_path(tmp_path):
+    """Grant waits beyond the SLO target burn fleet_slo_burn_total per
+    tenant; a grant timeout burns too (and still 503s)."""
+    p = _write(tmp_path, "slo.npz", seed=150)
+    svc = _start_replica(tmp_path, "fo-slo", deadline_s=3600.0, bucket_cap=8)
+    # slo_grant_s=0: even an immediate grant takes >0s, so every
+    # admission burns — deterministic without real queueing delays.
+    router = _start_router(svc, max_inflight=1, queue_timeout_s=0.2,
+                           slo_grant_s=0.0)
+    try:
+        first = _post_job(router, {"path": p},
+                          headers={"X-ICT-Tenant": "slo-t"})
+        assert first["replica_id"] == "fo-slo"
+        assert router.metrics.counter_value(
+            "fleet_slo_burn_total", {"tenant": "slo-t"}) == 1
+        # the budget is full and the replica parks the job: the second
+        # submission times out in the WFQ wait -> 503 + one more burn
+        exc = _post_job(router, {"path": p},
+                        headers={"X-ICT-Tenant": "slo-t"}, expect_error=True)
+        assert exc.code == 503
+        assert router.metrics.counter_value(
+            "fleet_slo_burn_total", {"tenant": "slo-t"}) == 2
+        svc.set_draining(True)
+        svc.drain(60)
+    finally:
+        router.stop()
+        svc.stop()
+
+
+@pytest.mark.skipif(not hasattr(signal, "SIGTERM"), reason="needs SIGTERM")
+def test_fleet_router_sigterm_dumps_flight_ring(tmp_path):
+    """serve_main parity: the real router process dumps its flight ring
+    under <spool>/flight on SIGTERM before the graceful stop."""
+    spool = tmp_path / "router_spool"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo_root + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "iterative_cleaner_tpu", "serve-fleet",
+         "--replica", "http://127.0.0.1:9", "--port", "0",
+         "--spool", str(spool), "--poll_interval_s", "30"],
+        stderr=subprocess.PIPE, text=True, env=env,
+        cwd=str(tmp_path))
+    try:
+        deadline = time.time() + 60
+        line = ""
+        while time.time() < deadline:
+            line = proc.stderr.readline()   # blocks until startup prints
+            if not line or "listening" in line:
+                break
+        assert "listening" in line, f"unexpected startup line: {line!r}"
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        assert rc == 0
+        dumps = os.listdir(spool / "flight")
+        assert any(n.startswith("flight-") and n.endswith(".json")
+                   for n in dumps)
+        with open(spool / "flight" / sorted(dumps)[-1]) as fh:
+            payload = json.load(fh)
+        assert payload["reason"] == "SIGTERM"
+        assert any(e.get("event") == "router_starting"
+                   for e in payload["events"])
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
