@@ -46,7 +46,11 @@ from dataclasses import dataclass, field
 from iterative_cleaner_tpu.analysis.engine import Finding, SourceFile
 from iterative_cleaner_tpu.analysis.rules import dotted_name
 
-#: The packages the detector walks (repo-relative prefixes).
+#: The packages the detector walks (repo-relative prefixes).  The
+#: fleet/ prefix covers the whole elastic tier — router, registry,
+#: tenants, obs, and (ISSUE 11) the capacity model and autoscaler
+#: (fleet/capacity.py, fleet/autoscale.py), whose locks sit strictly
+#: after the router's in the acquisition order.
 RACE_SCOPE_PREFIXES = (
     "iterative_cleaner_tpu/service/",
     "iterative_cleaner_tpu/obs/",

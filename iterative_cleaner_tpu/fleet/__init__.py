@@ -22,6 +22,16 @@ package puts a router process in front of N daemon replicas:
                      (``GET /fleet/trace/<id>``), incident bundles under
                      ``<spool>/fleet-incidents/``, and SLO/straggler
                      detection feeding placement de-prioritization
+- :mod:`.capacity` — the capacity model: per-bucket demand rates,
+                     per-replica utilization/service rates, and the
+                     cost-weighted backlog-drain ETA, rendered as
+                     ``ict_fleet_capacity_*`` gauges and served at
+                     ``GET /fleet/capacity``
+- :mod:`.autoscale`— signal-driven elastic scaling: the
+                     ReplicaSupervisor (spawn with full-jitter retries,
+                     drain-then-stop scale-down) and the hysteresis +
+                     cooldown Autoscaler behind ``--autoscale
+                     advise|act``
 
 The router is routing, not math: every mask is produced by a replica,
 and replicas stay bit-identical to the numpy oracle on every route

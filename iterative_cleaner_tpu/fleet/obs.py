@@ -271,6 +271,14 @@ class ScrapeCache:
             rec = self._scrapes.get(replica_id)
             return list(rec["flight"]) if rec is not None else []
 
+    def forget(self, replica_id: str) -> None:
+        """Drop one replica's cached scrape — the autoscaler's scale-down
+        path (a replica that LEFT the fleet must fall off the staleness
+        gauges instead of aging forever; a dead-but-configured replica
+        keeps its last good scrape, as before)."""
+        with self._lock:
+            self._scrapes.pop(replica_id, None)
+
 
 class TraceStore:
     """Bounded router-side span store, indexed by trace id.  LRU over
@@ -399,6 +407,15 @@ class StragglerDetector:
     def stragglers(self) -> set[str]:
         with self._lock:
             return set(self._flagged)
+
+    def forget(self, replica_id: str) -> None:
+        """Drop one replica's windows/flag — scale-down removal (its id
+        may be reused by a future spawn and must start clean)."""
+        with self._lock:
+            self._last_cum.pop(replica_id, None)
+            self._windows.pop(replica_id, None)
+            self._consec.pop(replica_id, None)
+            self._flagged.discard(replica_id)
 
 
 # --- incident bundles ---

@@ -150,6 +150,27 @@ class ReplicaRegistry:
                 return rep
         return None
 
+    # --- elastic membership (fleet/autoscale.py) ---
+
+    def add(self, base_url: str) -> Replica:
+        """Join one replica to the fleet at runtime — the autoscaler's
+        scale-up path.  Idempotent: re-adding a known URL returns the
+        existing record (its health history intact).  The new record is
+        not alive until its first good poll, exactly like a configured
+        replica at startup."""
+        url = base_url.rstrip("/")
+        with self._lock:
+            rep = self._replicas.get(url)
+            if rep is None:
+                rep = self._replicas[url] = Replica(base_url=url)
+            return rep
+
+    def remove(self, base_url: str) -> None:
+        """Leave the fleet — the autoscaler's post-drain scale-down path
+        (a removed replica is no longer polled, scored, or scraped)."""
+        with self._lock:
+            self._replicas.pop(base_url.rstrip("/"), None)
+
     def note_placed(self, base_url: str) -> None:
         with self._lock:
             rep = self._replicas.get(base_url)

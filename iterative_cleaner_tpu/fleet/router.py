@@ -49,6 +49,8 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from iterative_cleaner_tpu.fleet import autoscale as fleet_autoscale
+from iterative_cleaner_tpu.fleet import capacity as fleet_capacity
 from iterative_cleaner_tpu.fleet import obs as fleet_obs
 from iterative_cleaner_tpu.fleet.client import (
     ReplicaClient,
@@ -127,6 +129,22 @@ class FleetConfig:
     slo_grant_s: float = 1.0         # per-tenant SLO on the WFQ grant
                                      # wait; beyond it (or a grant
                                      # timeout) burns fleet_slo_burn_total
+    capacity_window: int = 8         # poll ticks per capacity-model rate
+                                     # window (fleet/capacity.py)
+    autoscale: str = "off"           # off | advise | act — the elastic
+                                     # scaling loop (fleet/autoscale.py);
+                                     # advise only emits recommendations
+    min_replicas: int = 1            # alive floor the scaler respects
+    max_replicas: int = 4            # alive ceiling
+    scale_up_eta_s: float = 10.0     # backlog-drain ETA that counts as
+                                     # "behind" toward a scale-up
+    scale_up_polls: int = 3          # hysteresis: consecutive behind polls
+    scale_down_polls: int = 6        # hysteresis: consecutive idle polls
+    scale_idle_util: float = 0.05    # fleet utilization under this = idle
+    scale_cooldown_s: float = 30.0   # quiet period after any decision
+    spawn_retries: int = 3           # full-jitter spawn retry ladder depth
+    spawn_args: tuple = ()           # extra ict-serve args for spawned
+                                     # subprocess replicas (--spawn_arg)
     quiet: bool = False
 
 
@@ -166,6 +184,21 @@ class Placement:
 
 def new_router_id() -> str:
     return f"fr-{uuid.uuid4().hex[:8]}"
+
+
+def _json_safe(obj):
+    """Replace IEEE specials with their string spellings so HTTP replies
+    stay strict JSON (json.dumps would emit the non-standard
+    ``Infinity`` token; ``float("inf")`` parses the string back)."""
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, float) and (obj != obj or obj in
+                                   (float("inf"), float("-inf"))):
+        return "nan" if obj != obj else (
+            "inf" if obj > 0 else "-inf")
+    return obj
 
 
 class RouterMetrics:
@@ -250,7 +283,7 @@ class FleetRouter:
     locks — acquisition order is always router -> registry/metrics,
     never the reverse."""
 
-    def __init__(self, cfg: FleetConfig) -> None:
+    def __init__(self, cfg: FleetConfig, replica_factory=None) -> None:
         if not cfg.replicas:
             raise ValueError("a fleet needs at least one --replica URL")
         self.cfg = cfg
@@ -282,12 +315,53 @@ class FleetRouter:
         self.straggler = fleet_obs.StragglerDetector(
             factor=cfg.straggler_factor, polls=cfg.straggler_polls,
             window=cfg.straggler_window)
+        # The capacity model (fleet/capacity.py): fed by the same poll
+        # tick, rendered as ict_fleet_capacity_* gauges and
+        # GET /fleet/capacity; its lock too sits strictly after the
+        # router's in the acquisition order.
+        self.capacity = fleet_capacity.CapacityModel(
+            window=cfg.capacity_window,
+            dispatch_phase=cfg.straggler_phase)
+        # The elastic-scaling loop (fleet/autoscale.py), off by default.
+        # The supervisor spawns in-process replicas when the embedder
+        # hands in a factory (tests, the autoscale smoke) and real
+        # ict-serve subprocesses otherwise, rooted under the router
+        # spool.
+        self.supervisor = None
+        self.autoscaler = None
+        if cfg.autoscale != "off":
+            factory = replica_factory or fleet_autoscale.\
+                SubprocessReplicaFactory(
+                    os.path.join(cfg.spool_dir, "replicas"),
+                    extra_args=cfg.spawn_args)
+            self.supervisor = fleet_autoscale.ReplicaSupervisor(
+                factory, self.registry, self.client,
+                spawn_retries=cfg.spawn_retries,
+                retry_backoff_s=cfg.retry_backoff_s,
+                note_spawn_failure=lambda: self.metrics.count(
+                    "fleet_scale_events_total",
+                    {"direction": "up", "reason": "spawn_failed"}),
+                quiet=cfg.quiet)
+            self.autoscaler = fleet_autoscale.Autoscaler(
+                fleet_autoscale.AutoscaleConfig(
+                    mode=cfg.autoscale,
+                    min_replicas=cfg.min_replicas,
+                    max_replicas=cfg.max_replicas,
+                    scale_up_eta_s=cfg.scale_up_eta_s,
+                    up_polls=cfg.scale_up_polls,
+                    down_polls=cfg.scale_down_polls,
+                    idle_utilization=cfg.scale_idle_util,
+                    cooldown_s=cfg.scale_cooldown_s))
         # Last observed (audit_divergences, backend) per replica: the
         # incident watch fires a bundle when divergences move or a
         # replica demotes jax -> numpy between polls.
         self._health_seen: dict[str, tuple[float, str]] = {}  # ict: guarded-by(self._lock)
         self._last_poll_mono = 0.0  # monotonic stamp of the last completed poll_tick  # ict: guarded-by(self._lock)
         self._placements: dict[str, Placement] = {}  # ict: guarded-by(self._lock)
+        # True while an acted scale-up's spawn thread runs: the
+        # autoscaler takes no new verdict mid-spawn (the fleet's size is
+        # in motion), but the poll loop itself stays live behind it.
+        self._scale_in_flight = False  # ict: guarded-by(self._lock)
         # idempotency key -> fleet job id ("" while a placement carrying
         # the key is in flight): the ROUTER-side half of the dedupe — a
         # client retry with a pinned key must not run the job again on a
@@ -349,6 +423,10 @@ class FleetRouter:
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
+        if self.supervisor is not None:
+            # Managed replicas die with their router (their spools keep
+            # any unfinished accepted work for the next life).
+            self.supervisor.stop_all()
         self._stop_evt.set()
         with self._lock:
             self._cond.notify_all()
@@ -390,6 +468,8 @@ class FleetRouter:
         self._refresh_open_placements()
         self._failover_sweep()
         self._update_replica_gauges()
+        self._update_capacity()
+        self._autoscale_tick()
         self._trim_placements()
         with self._lock:
             self._last_poll_mono = time.monotonic()
@@ -670,6 +750,207 @@ class FleetRouter:
         self.metrics.replace_gauge_family(
             "fleet_queued_submissions", {(): float(queued)})
 
+    def _update_capacity(self) -> None:
+        """Fold this tick's registry + scrape snapshots into the capacity
+        model and republish every ict_fleet_capacity_* /
+        ict_fleet_backlog_eta_seconds gauge family whole (fleet/capacity.py
+        — the figures every scale decision must be reconstructible
+        from)."""
+        self.capacity.update(self.registry.snapshot(),
+                             self.scrapes.snapshot())
+        for family, entries in self.capacity.gauge_families().items():
+            self.metrics.replace_gauge_family(family, entries)
+
+    def _autoscale_tick(self) -> None:
+        """The control loop's acting half: reap finished drains, ask the
+        Autoscaler for this tick's verdict, and (in act mode) execute it
+        — spawn on the supervisor's full-jitter ladder (on its OWN
+        thread: a slow or failing spawn must not stall health polling,
+        failover sweeps, or grant refresh — the one-wedged-replica
+        discipline applies to spawns too), or drain-then-stop the
+        least-loaded managed replica.  Every decision, advised, acted,
+        or un-executable, is counted
+        (fleet_scale_events_total{direction,reason}), event-logged,
+        flight-ringed, and written as an incident-style decision
+        bundle."""
+        if self.autoscaler is None:
+            return
+        for rec in self.supervisor.reap_drained():
+            # Drain-then-stop completed: the replica finished its
+            # accepted work and left the fleet — scrub its scrape,
+            # straggler, and health-watch state so the gauges don't
+            # carry a ghost.  Those caches key on the id the replica
+            # ADVERTISED, which need not equal the managed id.
+            rid = rec["replica_id"]
+            self.scrapes.forget(rid)
+            self.straggler.forget(rid)
+            with self._lock:
+                self._health_seen.pop(rid, None)
+            if events.active():
+                events.emit("fleet_scale_down_complete", replica_id=rid,
+                            managed_id=rec["managed_id"])
+            flight.note("fleet_scale_down_complete", replica_id=rid,
+                        managed_id=rec["managed_id"])
+            if not self.cfg.quiet:
+                print(f"ict-fleet: managed replica {rid} drained and "
+                      "stopped", file=sys.stderr)
+        with self._lock:
+            if self._scale_in_flight:
+                return   # one lifecycle action at a time: no new verdict
+                # while a spawn thread runs (its outcome changes `alive`)
+        snap = self.registry.snapshot()
+        alive = sum(1 for r in snap if r["alive"] and not r["draining"])
+        decision = self.autoscaler.tick(
+            self.capacity.snapshot(), alive=alive,
+            managed_up=len(self.supervisor.up_ids()),
+            slo_burn_total=self.metrics.counter_total(
+                "fleet_slo_burn_total"),
+            stragglers=len(self.straggler.stragglers()))
+        if decision is None:
+            return
+        direction, reason = decision["direction"], decision["reason"]
+        # The decision exists from this point on, whatever its outcome:
+        # counted first, so the counter can never miss one.
+        self.metrics.count("fleet_scale_events_total",
+                           {"direction": direction, "reason": reason})
+        if self.cfg.autoscale != "act":
+            self._record_scale_outcome(decision, "fleet_scale_advised",
+                                       acted=False)
+            return
+        if direction == "up":
+            with self._lock:
+                self._scale_in_flight = True
+            # Daemonic and deliberately NOT in self._threads: stop()
+            # must not wait out a 60 s spawn; a spawn that completes
+            # after stop() is unwound inside _execute_scale_up.
+            threading.Thread(
+                target=self._execute_scale_up, args=(decision,),
+                daemon=True,
+                name=f"ict-fleet-scale-{self.router_id}").start()
+            return
+        # direction == "down": one bounded HTTP call (replica_timeout_s),
+        # fine on the poll thread; the drain itself completes over later
+        # ticks (reap_drained above).
+        victim = self._pick_scale_down_victim()
+        if not victim or not self.supervisor.begin_drain(victim):
+            # Un-executable down decision (nothing drainable, or the
+            # drain call failed).  The Autoscaler already consumed the
+            # decision — cooldown armed, streaks reset — so it must NOT
+            # vanish from the telemetry: record it as failed.
+            decision["error"] = ("no drainable managed replica"
+                                 if not victim else
+                                 f"drain of {victim} refused/unreachable")
+            self._record_scale_outcome(decision, "fleet_scale_failed",
+                                       acted=False)
+            return
+        decision["replica_id"] = victim
+        if events.active():
+            events.emit("fleet_drain_requested", replica_id=victim,
+                        drain=True, initiator="autoscaler")
+        flight.note("fleet_drain_requested", replica_id=victim,
+                    drain=True, initiator="autoscaler")
+        self.registry.poll_once(self.client)
+        self._record_scale_outcome(decision, "fleet_scale_down",
+                                   acted=True)
+
+    def _execute_scale_up(self, decision: dict) -> None:
+        """The spawn half of an acted scale-up, off the poll thread.
+        While it runs, `_scale_in_flight` parks further verdicts (the
+        fleet's size is in motion); the poll loop itself keeps running —
+        health, failover, capacity all stay live behind a slow spawn."""
+        try:
+            try:
+                handle = self.supervisor.spawn_replica()
+            except fleet_autoscale.SpawnFailed as exc:
+                # Every failed attempt was already counted under
+                # reason="spawn_failed"; the giving-up is recorded too.
+                decision["error"] = str(exc)
+                self._record_scale_outcome(decision, "fleet_scale_failed",
+                                           acted=False)
+                return
+            if self._stop_evt.is_set():
+                # The router stopped while the spawn was in flight:
+                # unwind rather than leak a replica nobody supervises.
+                handle.stop()
+                self.registry.remove(handle.base_url)
+                return
+            decision["replica_id"] = handle.replica_id
+            # The new replica joins the registry now; poll it immediately
+            # so it is placeable on the next decision, not the one after.
+            self.registry.poll_once(self.client)
+            self._record_scale_outcome(decision, "fleet_scale_up",
+                                       acted=True)
+        finally:
+            with self._lock:
+                self._scale_in_flight = False
+
+    def _record_scale_outcome(self, decision: dict, event: str,
+                              acted: bool) -> None:
+        """The explainability tail every decision gets: event log +
+        flight ring + stderr + the incident-style decision bundle."""
+        replica_id = decision.get("replica_id", "")
+        if events.active():
+            events.emit(event, direction=decision["direction"],
+                        reason=decision["reason"], replica_id=replica_id,
+                        error=decision.get("error", ""),
+                        signals=decision.get("signals", {}))
+        flight.note(event, direction=decision["direction"],
+                    reason=decision["reason"], replica_id=replica_id)
+        if not self.cfg.quiet:
+            verb = ("scaling" if acted else
+                    "advising scale" if event == "fleet_scale_advised"
+                    else "FAILED scaling")
+            print(f"ict-fleet: {verb} {decision['direction']} "
+                  f"(reason: {decision['reason']}"
+                  f"{'; replica ' + replica_id if replica_id else ''}"
+                  f"{'; ' + decision['error'] if decision.get('error') else ''})",
+                  file=sys.stderr)
+        # The decision bundle: the write_incident_bundle discipline
+        # applied to scale decisions — the signals that fired it ride in
+        # the manifest, the capacity gauges in metrics.prom.  Bundle
+        # reason mirrors the event: scale_up / scale_down /
+        # scale_advised / scale_failed.
+        self._note_scale_bundle(decision, event[len("fleet_"):])
+
+    def _pick_scale_down_victim(self) -> str:
+        """The least-loaded managed-up replica — never a statically
+        configured one (operators own those), never the last replica.
+        Matched by base URL (the supervisor's identity), not by the
+        replica's self-reported id, which any --replica_id can set."""
+        managed = self.supervisor.up_urls()
+        if not managed:
+            return ""
+        cands = [(rep.load(), managed[rep.base_url])
+                 for rep in self.registry.candidates()
+                 if rep.base_url in managed]
+        return min(cands)[1] if cands else ""
+
+    def _note_scale_bundle(self, decision: dict, reason: str) -> None:
+        """One incident-style decision bundle per scale decision: the
+        manifest carries the decision + its input signals, metrics.prom
+        the router's own exposition (the capacity gauges included), so
+        the decision replays from the exported figures alone."""
+        with self._lock:
+            placements = [{
+                "job_id": p.job_id, "tenant": p.tenant,
+                "trace_id": p.trace_id, "state": p.state,
+                "replica_id": p.replica_id, "attempts": p.attempts,
+            } for p in self._placements.values()]
+        path = fleet_obs.write_incident_bundle(
+            self.incident_dir, reason=reason,
+            replica_id=decision.get("replica_id", ""),
+            placements=placements, replicas=self.registry.snapshot(),
+            metrics_text=self.metrics.render(),
+            flight_events=None,
+            trace={"decision": decision,
+                   "capacity": self.capacity.snapshot(),
+                   "autoscale": self.autoscaler.state()})
+        self.metrics.count("fleet_incidents_total", {"reason": reason})
+        if events.active():
+            events.emit("fleet_incident", reason=reason,
+                        replica_id=decision.get("replica_id", ""),
+                        bundle=path or "")
+
     def _trim_placements(self) -> None:
         """Bound the placement table by evicting the oldest TERMINAL
         records beyond ``placement_keep`` (job ids are time-sortable, the
@@ -785,6 +1066,10 @@ class FleetRouter:
             return {**body, "tenant": tenant, "router_id": self.router_id}
         self.metrics.count("fleet_placements_total",
                            {"replica": rep.replica_id or rep.base_url})
+        # Fresh demand only: failover re-routes and idempotent dedupes
+        # never reach here, so the capacity model's demand rate counts
+        # each submission exactly once.
+        self.capacity.note_placement(self._bucket_of(payload))
         self.traces.record(trace_id, "fleet_submit", job_id=placement.job_id,
                            tenant=tenant)
         self.traces.record(trace_id, "fleet_placement",
@@ -1012,6 +1297,35 @@ class FleetRouter:
                    if rec.get("families")}
         return self.metrics.render() + fleet_obs.federated_exposition(scrapes)
 
+    def fleet_capacity(self) -> dict:
+        """``GET /fleet/capacity``: the capacity model's last snapshot
+        (fleet figures, per-replica utilization/rates, per-bucket
+        backlog/demand/cost/ETA) plus the autoscaler's state — the JSON
+        twin of the ict_fleet_capacity_* gauge families.  IEEE specials
+        are stringified (``"inf"``) so the reply is STRICT JSON — the
+        gauge twin keeps the numeric ``+Inf`` under the exposition
+        grammar."""
+        snap = _json_safe(self.capacity.snapshot())
+        snap.setdefault("fleet", {})
+        snap.setdefault("replicas", {})
+        snap.setdefault("buckets", {})
+        snap["stragglers"] = sorted(self.straggler.stragglers())
+        snap["autoscale"] = (self.autoscaler.state()
+                             if self.autoscaler is not None else None)
+        # Keyed by the replica's ADVERTISED id (joinable against the
+        # /healthz rows and the capacity per-replica figures), with the
+        # supervisor's managed id alongside — the two id domains need
+        # not agree (--replica_id is the daemon's own business).
+        managed: dict[str, dict] = {}
+        if self.supervisor is not None:
+            by_url = {r["base_url"]: (r["replica_id"] or r["base_url"])
+                      for r in self.registry.snapshot()}
+            for mid, rec in self.supervisor.managed_info().items():
+                rid = by_url.get(rec["base_url"], rec["base_url"])
+                managed[rid] = {"state": rec["state"], "managed_id": mid}
+        snap["managed_replicas"] = managed
+        return snap
+
     def fleet_trace(self, trace_id: str) -> tuple[int, dict]:
         """``GET /fleet/trace/<id>``: one stitched cross-hop timeline.
 
@@ -1115,6 +1429,13 @@ class FleetRouter:
             "queued_submissions": queued,
             "inflight": inflight,
             "max_inflight": self.cfg.max_inflight,
+            # The capacity/autoscale state (ISSUE 11): the same figures
+            # the gauges export, summarized for load balancers and
+            # fleet_top.
+            "capacity": _json_safe(
+                self.capacity.snapshot().get("fleet", {})),
+            "autoscale": (self.autoscaler.state()
+                          if self.autoscaler is not None else None),
         }
 
     def drain_replica(self, replica_id: str, flag: bool) -> tuple[int, dict]:
@@ -1127,6 +1448,15 @@ class FleetRouter:
             return exc.status, exc.body
         except ReplicaUnreachable as exc:
             return 503, {"error": f"replica unreachable: {exc}"}
+        # Operator-initiated drains leave a trace-level record (event log
+        # + flight ring) — a replica that stopped taking placements must
+        # be explainable from the telemetry, not just observable in the
+        # registry.
+        if events.active():
+            events.emit("fleet_drain_requested", replica_id=replica_id,
+                        drain=bool(flag), initiator="operator")
+        flight.note("fleet_drain_requested", replica_id=replica_id,
+                    drain=bool(flag), initiator="operator")
         # Reflect the drain in the registry immediately — waiting for the
         # next poll would leave a placement window on a draining replica.
         self.registry.poll_once(self.client)
@@ -1181,6 +1511,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path == "/fleet/capacity":
+            self._reply(200, router.fleet_capacity())
         elif self.path.startswith("/fleet/trace/"):
             tid = self.path[len("/fleet/trace/"):]
             code, payload = router.fleet_trace(tid)
@@ -1336,6 +1668,51 @@ def build_fleet_parser() -> argparse.ArgumentParser:
                    help="per-tenant SLO on the placement-grant wait; a "
                         "longer wait (or a grant timeout) burns "
                         "fleet_slo_burn_total{tenant} (default 1.0)")
+    p.add_argument("--capacity_window", type=int, default=8, metavar="N",
+                   help="poll ticks per capacity-model rate window "
+                        "(utilization / service / demand rates; default 8)")
+    p.add_argument("--autoscale", choices=("off", "advise", "act"),
+                   default="off",
+                   help="elastic scaling loop driven by the capacity "
+                        "model + SLO/straggler signals: 'advise' only "
+                        "emits recommendations (events, counters, "
+                        "decision bundles), 'act' spawns/drains replicas "
+                        "(default off; docs/OBSERVABILITY.md)")
+    p.add_argument("--min_replicas", type=int, default=1, metavar="N",
+                   help="alive-replica floor the scaler respects "
+                        "(default 1)")
+    p.add_argument("--max_replicas", type=int, default=4, metavar="N",
+                   help="alive-replica ceiling for scale-ups (default 4)")
+    p.add_argument("--scale_up_eta_s", type=float, default=10.0,
+                   metavar="S",
+                   help="backlog-drain ETA that counts one poll as "
+                        "'behind'; --scale_up_polls consecutive behind "
+                        "polls fire a scale-up (default 10)")
+    p.add_argument("--scale_up_polls", type=int, default=3, metavar="K",
+                   help="hysteresis: consecutive behind polls before a "
+                        "scale-up decision (default 3)")
+    p.add_argument("--scale_down_polls", type=int, default=6, metavar="K",
+                   help="hysteresis: consecutive idle polls (zero "
+                        "backlog + demand, utilization under "
+                        "--scale_idle_util) before a drain-then-stop "
+                        "scale-down (default 6)")
+    p.add_argument("--scale_idle_util", type=float, default=0.05,
+                   metavar="F",
+                   help="fleet utilization below which an idle poll "
+                        "counts toward scale-down (default 0.05)")
+    p.add_argument("--scale_cooldown_s", type=float, default=30.0,
+                   metavar="S",
+                   help="quiet period after any scale decision — the "
+                        "anti-flapping guard (default 30)")
+    p.add_argument("--spawn_retries", type=int, default=3, metavar="N",
+                   help="full-jitter retries when a replica spawn fails "
+                        "(default 3; each failure counts "
+                        "fleet_scale_events_total{reason=spawn_failed})")
+    p.add_argument("--spawn_arg", action="append", default=[],
+                   metavar="ARG",
+                   help="extra ict-serve argument for autoscaler-spawned "
+                        "subprocess replicas (repeatable), e.g. "
+                        "--spawn_arg=--backend=numpy")
     p.add_argument("-q", "--quiet", action="store_true")
     p.add_argument("--smoke", action="store_true",
                    help="offline self-check: 2 in-process replicas behind "
@@ -1383,6 +1760,21 @@ def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
     if args.straggler_window < 1:
         raise ValueError(f"--straggler_window must be >= 1, got "
                          f"{args.straggler_window}")
+    if args.capacity_window < 1:
+        raise ValueError(f"--capacity_window must be >= 1, got "
+                         f"{args.capacity_window}")
+    if args.min_replicas < 1:
+        raise ValueError(f"--min_replicas must be >= 1, got "
+                         f"{args.min_replicas}")
+    if args.max_replicas < args.min_replicas:
+        raise ValueError(f"--max_replicas ({args.max_replicas}) must be "
+                         f">= --min_replicas ({args.min_replicas})")
+    if args.scale_up_polls < 1 or args.scale_down_polls < 1:
+        raise ValueError("--scale_up_polls/--scale_down_polls must be "
+                         ">= 1 (the hysteresis windows)")
+    if args.scale_cooldown_s < 0:
+        raise ValueError(f"--scale_cooldown_s must be >= 0, got "
+                         f"{args.scale_cooldown_s}")
     quotas, weights = parse_tenant_specs(args.tenant)
     return FleetConfig(
         replicas=tuple(args.replica),
@@ -1406,6 +1798,17 @@ def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
         straggler_window=args.straggler_window,
         straggler_phase=args.straggler_phase,
         slo_grant_s=args.slo_grant_s,
+        capacity_window=args.capacity_window,
+        autoscale=args.autoscale,
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
+        scale_up_eta_s=args.scale_up_eta_s,
+        scale_up_polls=args.scale_up_polls,
+        scale_down_polls=args.scale_down_polls,
+        scale_idle_util=args.scale_idle_util,
+        scale_cooldown_s=args.scale_cooldown_s,
+        spawn_retries=args.spawn_retries,
+        spawn_args=tuple(args.spawn_arg),
         quiet=args.quiet,
     )
 
@@ -1637,6 +2040,207 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
             svc_b.stop()
 
 
+def run_autoscale_smoke(cfg: FleetConfig) -> int:
+    """Offline autoscale self-check (the ``--smoke --autoscale act`` CI
+    lane): ONE in-process jax replica behind a router running the
+    capacity model + autoscaler in act mode.  An injected same-bucket
+    backlog must drive a scale-up to a second (supervisor-spawned,
+    in-process) replica; the post-drain idle must drive a
+    drain-then-stop scale-down back to one; every job completes (zero
+    lost) with masks bit-identical to the numpy oracle; >= 1 scale
+    decision bundle lands on disk; and the merged ``GET /fleet/metrics``
+    still passes the exact per-replica-sum equality check.  One JSON
+    line, rc 0/1."""
+    import tempfile
+    import urllib.request
+
+    import numpy as np
+
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.core.cleaner import clean_cube
+    from iterative_cleaner_tpu.io.npz import NpzIO
+    from iterative_cleaner_tpu.io.synthetic import make_archive
+    from iterative_cleaner_tpu.obs import tracing
+    from iterative_cleaner_tpu.ops.preprocess import preprocess
+    from iterative_cleaner_tpu.parallel.batch import finalize_weights
+    from iterative_cleaner_tpu.service.daemon import CleaningService
+    from iterative_cleaner_tpu.service.daemon import ServeConfig
+    from iterative_cleaner_tpu.service.jobs import TERMINAL
+
+    result = {"smoke": "FAIL"}
+    with tempfile.TemporaryDirectory(prefix="ict_autoscale_smoke_") as tmp:
+        paths = []
+        for i in range(4):
+            p = os.path.join(tmp, f"smoke{i}.npz")
+            NpzIO().save(make_archive(nsub=4, nchan=16, nbin=64,
+                                      seed=300 + i), p)
+            paths.append(p)
+
+        def serve_cfg(tag: str) -> ServeConfig:
+            return ServeConfig(
+                spool_dir=os.path.join(tmp, f"spool_{tag}"), port=0,
+                replica_id=f"smoke-{tag}", deadline_s=0.2, quiet=True,
+                clean=CleanConfig(backend="jax", quiet=True))
+
+        svc = CleaningService(serve_cfg("seed"))
+        svc.start()
+        factory = fleet_autoscale.InProcessReplicaFactory(
+            lambda rid: serve_cfg(rid))
+        # Hermetic overrides (the run_smoke idiom): the replica set, the
+        # port, the spool, and the poll loop are the smoke's own (ticks
+        # are driven BY HAND for determinism); scaling thresholds drop
+        # to a snappy cadence when the operator left them at the
+        # defaults, and stay honored otherwise.
+        router = FleetRouter(FleetConfig(**{
+            **cfg.__dict__,
+            "replicas": (f"http://127.0.0.1:{svc.port}",),
+            "port": 0,
+            "poll_interval_s": 999.0,   # manual, deterministic ticks
+            "spool_dir": os.path.join(tmp, "router_spool"),
+            "min_replicas": 1,
+            "max_replicas": 2,
+            "scale_up_polls": (
+                2 if cfg.scale_up_polls == FleetConfig.scale_up_polls
+                else cfg.scale_up_polls),
+            "scale_up_eta_s": (
+                0.5 if cfg.scale_up_eta_s == FleetConfig.scale_up_eta_s
+                else cfg.scale_up_eta_s),
+            "scale_down_polls": (
+                3 if cfg.scale_down_polls == FleetConfig.scale_down_polls
+                else cfg.scale_down_polls),
+            "scale_cooldown_s": (
+                1.0 if cfg.scale_cooldown_s == FleetConfig.scale_cooldown_s
+                else cfg.scale_cooldown_s),
+        }), replica_factory=factory)
+        router.start()
+        jobs = {}
+        try:
+            base = f"http://{router.cfg.host}:{router.port}"
+            before_done = tracing.counters_snapshot().get(
+                "service_jobs_done", 0)
+
+            def submit(p):
+                req = urllib.request.Request(
+                    f"{base}/jobs",
+                    data=json.dumps({"path": p,
+                                     "shape": [4, 16, 64]}).encode(),
+                    headers={"Content-Type": "application/json"})
+                return json.load(urllib.request.urlopen(req, timeout=30))
+
+            # Phase 1 — inject a same-bucket backlog (the first jax
+            # dispatch compiles, so the queue genuinely sits) and tick
+            # until the autoscaler acts: a second replica must join.
+            for p in paths:
+                jobs[p] = submit(p)
+            scaled_up = False
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                router.poll_tick()
+                if len(router.registry.snapshot()) >= 2:
+                    scaled_up = True
+                    break
+                time.sleep(0.05)
+            # Phase 2 — more traffic lands on the grown fleet; every job
+            # must turn terminal through the router.
+            extra = []
+            for i in range(2):
+                p = os.path.join(tmp, f"extra{i}.npz")
+                NpzIO().save(make_archive(nsub=4, nchan=16, nbin=64,
+                                          seed=400 + i), p)
+                extra.append(p)
+                jobs[p] = submit(p)
+            states = {}
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                router.poll_tick()
+                states = {p: json.load(urllib.request.urlopen(
+                    f"{base}/jobs/{j['id']}", timeout=10))
+                    for p, j in jobs.items()}
+                if all(s.get("state") in TERMINAL for s in states.values()):
+                    break
+                time.sleep(0.05)
+            all_done = all(s.get("state") == "done"
+                           for s in states.values())
+            masks_ok = all_done
+            if all_done:
+                cfg_np = CleanConfig(backend="numpy")
+                for p in jobs:
+                    want, _rfi = finalize_weights(
+                        clean_cube(*preprocess(NpzIO().load(p)),
+                                   cfg_np).weights, cfg_np)
+                    got = NpzIO().load(states[p]["out_path"])
+                    if not np.array_equal(got.weights, want):
+                        masks_ok = False
+            done_delta = tracing.counters_snapshot().get(
+                "service_jobs_done", 0) - before_done
+            # Phase 3 — sustained idle: the scaler must drain-then-stop
+            # the managed replica (back to the one seed replica).
+            scaled_down = False
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                router.poll_tick()
+                managed = (router.supervisor.managed()
+                           if router.supervisor else {})
+                if (managed
+                        and all(s == "stopped" for s in managed.values())
+                        and len(router.registry.snapshot()) == 1):
+                    scaled_down = True
+                    break
+                time.sleep(0.05)
+            up_events = router.metrics.counter_value(
+                "fleet_scale_events_total",
+                {"direction": "up", "reason": "backlog"})
+            down_events = router.metrics.counter_value(
+                "fleet_scale_events_total",
+                {"direction": "down", "reason": "idle"})
+            bundles = [b for b in fleet_obs.list_incidents(
+                router.incident_dir)
+                if str(b.get("reason", "")).startswith("scale_")]
+            # The merged federation view must still hold exactly, and
+            # the capacity gauges the decisions are explained by must be
+            # on it.
+            fleet_text = urllib.request.urlopen(
+                f"{base}/fleet/metrics", timeout=10).read().decode()
+            fleet_ok = False
+            capacity_ok = False
+            try:
+                fams = obs_metrics.parse_exposition(fleet_text)
+            except ValueError:
+                fams = []
+            if fams:
+                fleet_ok = _merged_counters_equal(fams)
+                names = {fam.name for fam in fams}
+                capacity_ok = (
+                    any(n.startswith("ict_fleet_capacity_")
+                        for n in names)
+                    and "ict_fleet_backlog_eta_seconds" in names
+                    and "ict_fleet_scale_events_total" in names)
+            ok = (scaled_up and scaled_down and all_done and masks_ok
+                  and done_delta == len(jobs)
+                  and up_events >= 1 and down_events >= 1
+                  and len(bundles) >= 1 and fleet_ok and capacity_ok)
+            result = {
+                "smoke": "ok" if ok else "FAIL",
+                "jobs": len(jobs),
+                "jobs_done": sum(1 for s in states.values()
+                                 if s.get("state") == "done"),
+                "completions": int(done_delta),
+                "scaled_up": bool(scaled_up),
+                "scaled_down": bool(scaled_down),
+                "scale_up_events": int(up_events),
+                "scale_down_events": int(down_events),
+                "scale_decision_bundles": len(bundles),
+                "mask_identical_to_oracle": bool(masks_ok),
+                "fleet_metrics_merged_ok": bool(fleet_ok),
+                "capacity_gauges_ok": bool(capacity_ok),
+            }
+            return 0 if ok else 1
+        finally:
+            print(json.dumps(result))
+            router.stop()
+            svc.stop()
+
+
 def fleet_main(argv: list[str] | None = None) -> int:
     args = build_fleet_parser().parse_args(argv)
     try:
@@ -1645,6 +2249,11 @@ def fleet_main(argv: list[str] | None = None) -> int:
         print(f"error: {exc}", file=sys.stderr)
         return 2
     if args.smoke:
+        # --smoke --autoscale act runs the elastic-scaling self-check
+        # (backlog-driven scale-up, drain-then-stop scale-down); the
+        # plain smoke keeps covering placement/failover/federation.
+        if cfg.autoscale == "act":
+            return run_autoscale_smoke(cfg)
         return run_fleet_smoke(cfg)
     try:
         router = FleetRouter(cfg)

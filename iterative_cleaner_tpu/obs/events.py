@@ -90,6 +90,16 @@ def _sink_path_locked() -> str | None:
     return _explicit
 
 
+def configured_sink() -> str | None:
+    """The explicitly :func:`configure`-d JSON-lines sink path, or None
+    when disabled / deferring to ``ICT_TELEMETRY``.  The in-process
+    replica factory (fleet/autoscale.py) reads this so a replica spawned
+    MID-RUN inherits the router's sink instead of resetting the
+    process-global configuration out from under it."""
+    with _lock:
+        return None if _explicit is _UNSET else _explicit
+
+
 def enabled() -> bool:
     """Whether an event sink is active (the one check every hook makes)."""
     if _explicit is _UNSET:

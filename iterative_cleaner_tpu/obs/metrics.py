@@ -50,10 +50,21 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 def _fmt(value: float) -> str:
     """Prometheus sample value: integral floats render as ints (bucket
-    counts must not read as '3.0' in a strict parser)."""
-    if float(value).is_integer():
+    counts must not read as '3.0' in a strict parser), and the IEEE
+    specials render as the exposition's ``+Inf``/``-Inf``/``NaN``
+    spellings (repr's ``inf`` would fail the strict sample grammar —
+    the capacity model's backlog-drain ETA is legitimately ``+Inf``
+    while backlog exists with a zero observed service rate)."""
+    value = float(value)
+    if value != value:
+        return "NaN"
+    if value == float("inf"):
+        return "+Inf"
+    if value == float("-inf"):
+        return "-Inf"
+    if value.is_integer():
         return str(int(value))
-    return repr(float(value))
+    return repr(value)
 
 
 def _escape(value: str) -> str:

@@ -1,0 +1,698 @@
+"""ict-autoscale (ISSUE 11): capacity observability + elastic scaling.
+
+Units: the capacity model's windowed utilization/service/demand rates and
+cost-weighted backlog-drain ETA from synthetic scrapes (a deterministic
+fake clock), the +Inf gauge rendering under the strict grammar, the
+Autoscaler's hysteresis/cooldown state machine from synthetic snapshots,
+and the supervisor's full-jitter spawn-retry ladder (seeded RNG, recorded
+sleeps).  End to end against in-process fleets: an injected same-bucket
+backlog drives advise-mode recommendations and act-mode scale-up within
+the hysteresis window; sustained idle drives a drain-then-stop scale-down
+with zero lost jobs and oracle-identical masks; operator and autoscaler
+drains leave fleet_drain_requested trace records; tools/fleet_top.py
+snapshots the whole plane offline.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from test_fleet import (
+    _get,
+    _oracle_weights,
+    _post_job,
+    _start_replica,
+    _start_router,
+    _write,
+)
+from test_observability import _parse_prometheus
+from iterative_cleaner_tpu.fleet import autoscale as fleet_autoscale
+from iterative_cleaner_tpu.fleet import capacity as fleet_capacity
+from iterative_cleaner_tpu.fleet import obs as fleet_obs
+from iterative_cleaner_tpu.fleet.autoscale import (
+    Autoscaler,
+    AutoscaleConfig,
+    InProcessReplicaFactory,
+    ReplicaSupervisor,
+    SpawnFailed,
+)
+from iterative_cleaner_tpu.fleet.capacity import CapacityModel
+from iterative_cleaner_tpu.fleet.registry import ReplicaRegistry
+from iterative_cleaner_tpu.fleet.router import RouterMetrics
+from iterative_cleaner_tpu.io.npz import NpzIO
+from iterative_cleaner_tpu.obs import metrics as obs_metrics
+from iterative_cleaner_tpu.obs.metrics import MetricFamily
+from iterative_cleaner_tpu.service.jobs import TERMINAL
+from iterative_cleaner_tpu.utils import backoff
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- unit: the capacity model ---
+
+
+def _replica_row(rid, bucket_depths=None, bucketed=None, load_q=0,
+                 dispatch_q=0, alive=True, draining=False):
+    if bucketed is None:
+        # a real /healthz keeps bucketed_cubes == sum of the per-bucket
+        # depths; the fake rows stay consistent the same way
+        bucketed = sum((bucket_depths or {}).values())
+    return {
+        "base_url": f"http://x/{rid}", "replica_id": rid, "alive": alive,
+        "draining": draining, "consecutive_failures": 0,
+        "open_jobs": 0, "load_queue_depth": load_q,
+        "dispatch_queue_depth": dispatch_q, "bucketed_cubes": bucketed,
+        "bucket_queue_depths": dict(bucket_depths or {}),
+        "warm_shapes": [], "backend": "jax", "version": "t",
+        "audits_run": 0, "audit_divergences": 0,
+    }
+
+
+def _scrape_rec(busy_s=0.0, done=0.0, exec_bytes=None):
+    """A parsed-scrape record shaped like ScrapeCache.snapshot()'s."""
+    fams = []
+    fam = MetricFamily(name="ict_service_dispatch_s", kind="counter")
+    fam.samples.append(("ict_service_dispatch_s", (),
+                        obs_metrics._fmt(busy_s)))
+    fams.append(fam)
+    fam = MetricFamily(name="ict_service_jobs_done", kind="counter")
+    fam.samples.append(("ict_service_jobs_done", (),
+                        obs_metrics._fmt(done)))
+    fams.append(fam)
+    if exec_bytes:
+        fam = MetricFamily(name="ict_executable_bytes_accessed",
+                           kind="gauge")
+        for bucket, v in exec_bytes.items():
+            fam.samples.append(("ict_executable_bytes_accessed",
+                                (("shape_bucket", bucket),),
+                                obs_metrics._fmt(v)))
+        fams.append(fam)
+    return {"families": fams, "ok": True}
+
+
+class _FakeClock:
+    def __init__(self, t0=1000.0):
+        self.t = t0
+
+    def monotonic(self):
+        return self.t
+
+    def time(self):
+        return self.t
+
+
+class TestCapacityModel:
+    def test_windowed_rates_utilization_and_eta(self, monkeypatch):
+        """Utilization = windowed dispatch busy-seconds / wall; service
+        rate = windowed completions / wall; demand from note_placement;
+        the ETA is +Inf while backlog exists with zero observed rate and
+        backlog/rate once completions flow."""
+        clock = _FakeClock()
+        monkeypatch.setattr(fleet_capacity.time, "monotonic",
+                            clock.monotonic)
+        monkeypatch.setattr(fleet_capacity.time, "time", clock.time)
+        model = CapacityModel(window=4)
+        rows = [_replica_row("r-a", bucket_depths={"4x16x64": 3})]
+        model.note_placement("4x16x64")
+        model.note_placement("4x16x64")
+        snap = model.update(rows, {"r-a": _scrape_rec(busy_s=0.0, done=0)})
+        # First tick: no wall time yet, rates are 0 — backlog still real.
+        assert snap["fleet"]["backlog"] == 3.0
+        assert snap["fleet"]["backlog_eta_s"] == float("inf")
+        clock.t += 2.0
+        snap = model.update(rows, {"r-a": _scrape_rec(busy_s=1.0, done=4)})
+        rep = snap["replicas"]["r-a"]
+        assert rep["utilization"] == pytest.approx(0.5)   # 1 busy / 2 wall
+        assert rep["service_rate"] == pytest.approx(2.0)  # 4 done / 2 wall
+        assert snap["fleet"]["service_rate"] == pytest.approx(2.0)
+        assert snap["fleet"]["demand_rate"] == pytest.approx(1.0)  # 2 / 2s
+        assert snap["buckets"]["4x16x64"]["backlog"] == 3.0
+        assert snap["fleet"]["backlog_eta_s"] == pytest.approx(1.5)
+        # A replica restart (counters reset) must clamp to zero deltas,
+        # never negative rates.
+        clock.t += 2.0
+        snap = model.update(rows, {"r-a": _scrape_rec(busy_s=0.0, done=0)})
+        assert snap["replicas"]["r-a"]["service_rate"] >= 0.0
+
+    def test_cost_weighted_eta(self, monkeypatch):
+        """A queued cube of a 2x-cost bucket weighs 2x a 1x one: the
+        per-bucket ETAs split by the exec-analysis bytes figures while
+        the raw backlog gauge stays in cubes."""
+        clock = _FakeClock()
+        monkeypatch.setattr(fleet_capacity.time, "monotonic",
+                            clock.monotonic)
+        monkeypatch.setattr(fleet_capacity.time, "time", clock.time)
+        model = CapacityModel(window=4)
+        rows = [_replica_row("r-a", bucket_depths={"big": 2, "small": 2})]
+        costs = {"big": 2e9, "small": 1e9}
+        model.update(rows, {"r-a": _scrape_rec(done=0,
+                                               exec_bytes=costs)})
+        clock.t += 1.0
+        snap = model.update(rows, {"r-a": _scrape_rec(
+            busy_s=1.0, done=2, exec_bytes=costs)})
+        # mean cost 1.5e9 -> weights 4/3 and 2/3; rate = 2 jobs/s
+        assert snap["buckets"]["big"]["eta_s"] == pytest.approx(
+            2 * (2e9 / 1.5e9) / 2.0)
+        assert snap["buckets"]["small"]["eta_s"] == pytest.approx(
+            2 * (1e9 / 1.5e9) / 2.0)
+        assert snap["fleet"]["backlog"] == 4.0
+        assert snap["fleet"]["backlog_weighted"] == pytest.approx(4.0)
+
+    def test_inf_eta_renders_grammar_clean(self):
+        """The +Inf backlog ETA must render as the exposition's '+Inf'
+        (repr's 'inf' fails the strict sample grammar) and round-trip."""
+        m = RouterMetrics()
+        m.set_gauge("fleet_backlog_eta_seconds", None, float("inf"))
+        text = m.render()
+        assert "ict_fleet_backlog_eta_seconds +Inf" in text
+        fams = obs_metrics.parse_exposition(text)
+        assert obs_metrics.render_exposition(fams) == text
+        _parse_prometheus(text)
+
+    def test_gauge_families_replace_whole(self, monkeypatch):
+        """Every capacity family is republished whole per tick: a bucket
+        that drained drops off the exposition instead of freezing."""
+        clock = _FakeClock()
+        monkeypatch.setattr(fleet_capacity.time, "monotonic",
+                            clock.monotonic)
+        monkeypatch.setattr(fleet_capacity.time, "time", clock.time)
+        model = CapacityModel(window=2)
+        model.update([_replica_row("r-a", bucket_depths={"b1": 2})],
+                     {"r-a": _scrape_rec()})
+        fams = model.gauge_families()
+        assert fams["fleet_capacity_bucket_backlog"] == {
+            (("bucket", "b1"),): 2.0}
+        clock.t += 1.0
+        model.update([_replica_row("r-a")], {"r-a": _scrape_rec()})
+        fams = model.gauge_families()
+        assert fams["fleet_capacity_bucket_backlog"] == {}
+        assert set(fams) >= {"fleet_capacity_utilization",
+                             "fleet_capacity_service_rate",
+                             "fleet_capacity_demand_rate",
+                             "fleet_capacity_backlog",
+                             "fleet_backlog_eta_seconds"}
+
+
+# --- unit: the autoscaler state machine ---
+
+
+def _snap(backlog=0.0, eta=0.0, util=0.0, demand=0.0):
+    return {"fleet": {"backlog": backlog, "backlog_eta_s": eta,
+                      "utilization": util, "demand_rate": demand}}
+
+
+BEHIND = _snap(backlog=5.0, eta=float("inf"), util=1.0, demand=2.0)
+IDLE = _snap()
+
+
+class TestAutoscaler:
+    def test_hysteresis_then_scale_up(self):
+        sc = Autoscaler(AutoscaleConfig(mode="act", up_polls=3,
+                                        max_replicas=4, cooldown_s=0.0))
+        kw = dict(alive=1, managed_up=0, slo_burn_total=0.0, stragglers=0)
+        assert sc.tick(BEHIND, now_mono=1.0, **kw) is None
+        assert sc.tick(BEHIND, now_mono=2.0, **kw) is None
+        decision = sc.tick(BEHIND, now_mono=3.0, **kw)
+        assert decision["direction"] == "up"
+        assert decision["reason"] == "backlog"
+        assert decision["signals"]["backlog"] == 5.0
+        # one in-bounds poll resets the streak
+        assert sc.tick(IDLE, now_mono=4.0, **kw) is None
+        assert sc.tick(BEHIND, now_mono=5.0, **kw) is None
+
+    def test_bounds_respected(self):
+        sc = Autoscaler(AutoscaleConfig(mode="act", up_polls=1,
+                                        down_polls=1, min_replicas=1,
+                                        max_replicas=2, cooldown_s=0.0))
+        # at the ceiling: no up
+        assert sc.tick(BEHIND, alive=2, managed_up=1, slo_burn_total=0,
+                       stragglers=0, now_mono=1.0) is None
+        # at the floor: no down
+        assert sc.tick(IDLE, alive=1, managed_up=1, slo_burn_total=0,
+                       stragglers=0, now_mono=2.0) is None
+        # nothing managed to drain: no down even above the floor
+        assert sc.tick(IDLE, alive=2, managed_up=0, slo_burn_total=0,
+                       stragglers=0, now_mono=3.0) is None
+        decision = sc.tick(IDLE, alive=2, managed_up=1, slo_burn_total=0,
+                           stragglers=0, now_mono=4.0)
+        assert decision["direction"] == "down"
+        assert decision["reason"] == "idle"
+
+    def test_cooldown_suppresses_flapping(self):
+        """An oscillating load (behind <-> idle every poll) with 1-poll
+        hysteresis fires exactly ONE decision per cooldown window; with
+        cooldown off it would flap every poll."""
+        sc = Autoscaler(AutoscaleConfig(mode="act", up_polls=1,
+                                        down_polls=1, min_replicas=1,
+                                        max_replicas=4, cooldown_s=60.0))
+        kw = dict(alive=2, managed_up=1, slo_burn_total=0.0, stragglers=0)
+        decisions = []
+        for i in range(20):
+            snap = BEHIND if i % 2 == 0 else IDLE
+            d = sc.tick(snap, now_mono=float(i), **kw)
+            if d is not None:
+                decisions.append(d)
+        assert len(decisions) == 1          # the cooldown held
+        state = sc.state(now_mono=20.0)
+        assert state["cooldown_remaining_s"] > 0
+        # control: no cooldown -> the same load flaps
+        sc2 = Autoscaler(AutoscaleConfig(mode="act", up_polls=1,
+                                         down_polls=1, min_replicas=1,
+                                         max_replicas=4, cooldown_s=0.0))
+        flaps = sum(1 for i in range(20)
+                    if sc2.tick(BEHIND if i % 2 == 0 else IDLE,
+                                now_mono=float(i), **kw) is not None)
+        assert flaps > 5
+
+    def test_slo_burn_and_straggler_reasons(self):
+        sc = Autoscaler(AutoscaleConfig(mode="act", up_polls=1,
+                                        max_replicas=4, cooldown_s=0.0))
+        # burn moved while backlogged -> pressure scale-up
+        d = sc.tick(_snap(backlog=2.0, eta=0.1), alive=1, managed_up=0,
+                    slo_burn_total=3.0, stragglers=0, now_mono=1.0)
+        assert d is not None and d["reason"] == "slo_burn"
+        # straggler flagged while backlogged
+        d = sc.tick(_snap(backlog=2.0, eta=0.1), alive=1, managed_up=0,
+                    slo_burn_total=3.0, stragglers=1, now_mono=2.0)
+        assert d is not None and d["reason"] == "straggler"
+        # backlog with a healthy ETA and no pressure: no decision
+        assert sc.tick(_snap(backlog=2.0, eta=0.1), alive=1, managed_up=0,
+                       slo_burn_total=3.0, stragglers=0,
+                       now_mono=3.0) is None
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            Autoscaler(AutoscaleConfig(mode="bogus"))
+        with pytest.raises(ValueError):
+            Autoscaler(AutoscaleConfig(min_replicas=0))
+        with pytest.raises(ValueError):
+            Autoscaler(AutoscaleConfig(min_replicas=3, max_replicas=2))
+
+
+# --- unit: the supervisor's spawn ladder ---
+
+
+class _FlakyFactory:
+    def __init__(self, fail_n):
+        self.fail_n = fail_n
+        self.calls = 0
+
+    def spawn(self, replica_id):
+        self.calls += 1
+        if self.calls <= self.fail_n:
+            raise OSError(f"bind race #{self.calls}")
+        return fleet_autoscale.ReplicaHandle(
+            replica_id=replica_id, base_url="http://127.0.0.1:1",
+            stop=lambda: None)
+
+
+class TestSupervisorSpawnLadder:
+    def test_spawn_retries_full_jitter_then_succeeds(self, monkeypatch):
+        """Two failed attempts walk the seeded full-jitter ladder (the
+        recorded sleeps equal the deterministic draws), every failure is
+        surfaced, and the third attempt lands + registers."""
+        sleeps = []
+        monkeypatch.setattr(fleet_autoscale.time, "sleep", sleeps.append)
+        failures = []
+        registry = ReplicaRegistry(["http://seed"])
+        factory = _FlakyFactory(fail_n=2)
+        sup = ReplicaSupervisor(
+            factory, registry, None, spawn_retries=3,
+            retry_backoff_s=0.25, rng=backoff.make_rng(7),
+            note_spawn_failure=lambda: failures.append(1))
+        handle = sup.spawn_replica()
+        assert factory.calls == 3
+        assert len(failures) == 2
+        want_rng = backoff.make_rng(7)
+        want = [backoff.full_jitter(0.25, a, rng=want_rng)
+                for a in range(2)]
+        assert sleeps == want
+        assert sup.managed() == {handle.replica_id: "up"}
+        assert registry.get("http://127.0.0.1:1") is not None
+
+    def test_spawn_ladder_exhausted_raises(self, monkeypatch):
+        monkeypatch.setattr(fleet_autoscale.time, "sleep", lambda s: None)
+        failures = []
+        sup = ReplicaSupervisor(
+            _FlakyFactory(fail_n=99), ReplicaRegistry(["http://seed"]),
+            None, spawn_retries=2, rng=backoff.make_rng(7),
+            note_spawn_failure=lambda: failures.append(1))
+        with pytest.raises(SpawnFailed) as exc_info:
+            sup.spawn_replica()
+        assert exc_info.value.attempts == 3
+        assert len(failures) == 3
+        assert sup.managed() == {}
+
+
+# --- e2e: in-process fleets ---
+
+
+def _serve_cfg_factory(tmp_path, **kw):
+    """An InProcessReplicaFactory whose replicas mirror _start_replica's
+    numpy defaults (spool under the test tmp, ephemeral port)."""
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.service import ServeConfig
+
+    def make(rid):
+        defaults = dict(spool_dir=str(tmp_path / f"spool_{rid}"), port=0,
+                        replica_id=rid, deadline_s=0.2, quiet=True,
+                        retry_backoff_s=0.01,
+                        clean=CleanConfig(backend="numpy", max_iter=3,
+                                          quiet=True, no_log=True))
+        defaults.update(kw)
+        return ServeConfig(**defaults)
+
+    return InProcessReplicaFactory(make)
+
+
+def _tick_until(router, pred, timeout_s=60.0, sleep_s=0.02):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        router.poll_tick()
+        if pred():
+            return True
+        time.sleep(sleep_s)
+    return False
+
+
+def test_backlog_scale_up_advise_then_act(tmp_path):
+    """The acceptance flow: an injected same-bucket backlog triggers a
+    scale-up ADVISE (events + counters + decision bundle, replica count
+    untouched), and the same load in act mode spawns a second replica
+    within the hysteresis window."""
+    # The seed replica parks decoded cubes forever (huge deadline, wide
+    # bucket): a same-bucket backlog that cannot drain.
+    svc = _start_replica(tmp_path, "as-seed", deadline_s=3600.0,
+                         bucket_cap=8)
+    paths = [_write(tmp_path, f"up{i}.npz", seed=i) for i in range(3)]
+    scale_kw = dict(capacity_window=4, min_replicas=1, max_replicas=2,
+                    scale_up_polls=2, scale_up_eta_s=0.5,
+                    scale_down_polls=50, scale_cooldown_s=0.1)
+    try:
+        # --- advise (the default posture): recommendations only ---
+        router = _start_router(svc, autoscale="advise",
+                               replica_factory=_serve_cfg_factory(tmp_path),
+                               **scale_kw)
+        try:
+            for p in paths:
+                _post_job(router, {"path": p, "shape": [4, 16, 64]})
+            assert _tick_until(router, lambda: router.metrics.counter_value(
+                "fleet_scale_events_total",
+                {"direction": "up", "reason": "backlog"}) >= 1)
+            # advised, never acted: no replica joined, nothing managed
+            assert len(router.registry.snapshot()) == 1
+            assert router.supervisor.managed() == {}
+            reasons = [b.get("reason") for b in fleet_obs.list_incidents(
+                router.incident_dir)]
+            assert "scale_advised" in reasons
+            assert router.health()["autoscale"]["mode"] == "advise"
+        finally:
+            router.stop()
+        # --- act: the same backlog spawns a managed replica ---
+        router = _start_router(svc, autoscale="act",
+                               replica_factory=_serve_cfg_factory(tmp_path),
+                               **scale_kw)
+        try:
+            for p in paths:
+                _post_job(router, {"path": p, "shape": [4, 16, 64]})
+            assert _tick_until(
+                router, lambda: len(router.registry.snapshot()) == 2)
+            managed = router.supervisor.managed()
+            assert list(managed.values()) == ["up"]
+            assert router.metrics.counter_value(
+                "fleet_scale_events_total",
+                {"direction": "up", "reason": "backlog"}) >= 1
+            reasons = [b.get("reason") for b in fleet_obs.list_incidents(
+                router.incident_dir)]
+            assert "scale_up" in reasons
+            # the decision is reconstructible from the exposition alone:
+            # capacity gauges + the scale-event counter, strict grammar
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{router.port}/fleet/metrics",
+                timeout=10).read().decode()
+            fams = obs_metrics.parse_exposition(text)
+            names = {f.name for f in fams}
+            assert "ict_fleet_capacity_backlog" in names
+            assert "ict_fleet_backlog_eta_seconds" in names
+            assert "ict_fleet_scale_events_total" in names
+            assert "ict_fleet_capacity_bucket_backlog" in names
+        finally:
+            router.stop()
+    finally:
+        svc.stop()
+
+
+def test_idle_scale_down_drain_then_stop_zero_lost(tmp_path):
+    """The full elastic cycle: backlog scales up to 2, traffic drains on
+    the grown fleet with oracle-identical masks, sustained idle
+    drain-then-stops the MANAGED replica (never the seed), and no job is
+    lost anywhere in between."""
+    from iterative_cleaner_tpu.obs import tracing
+
+    svc = _start_replica(tmp_path, "dn-seed")   # fast: deadline 0.2
+    telemetry = tmp_path / "events.jsonl"
+    router = _start_router(
+        svc, autoscale="act", telemetry=str(telemetry),
+        replica_factory=_serve_cfg_factory(tmp_path),
+        capacity_window=2, min_replicas=1, max_replicas=2,
+        scale_up_polls=1, scale_up_eta_s=0.0,
+        scale_down_polls=2, scale_idle_util=0.5, scale_cooldown_s=0.2)
+    try:
+        before_done = tracing.counters_snapshot().get(
+            "service_jobs_done", 0)
+        paths = [_write(tmp_path, f"dn{i}.npz", seed=20 + i)
+                 for i in range(4)]
+        jobs = {p: _post_job(router, {"path": p, "shape": [4, 16, 64]})
+                for p in paths}
+        assert _tick_until(
+            router, lambda: len(router.registry.snapshot()) == 2)
+        # a second wave lands on the grown fleet (the managed replica is
+        # the least-loaded candidate, so it takes real work)
+        extra = [_write(tmp_path, f"dx{i}.npz", seed=30 + i)
+                 for i in range(2)]
+        for p in extra:
+            jobs[p] = _post_job(router, {"path": p, "shape": [4, 16, 64]})
+        assert _tick_until(router, lambda: all(
+            _get(router, f"/jobs/{j['id']}").get("state") in TERMINAL
+            for j in jobs.values()), timeout_s=120.0)
+        states = {p: _get(router, f"/jobs/{j['id']}")
+                  for p, j in jobs.items()}
+        assert all(s["state"] == "done" for s in states.values())
+        for p, s in states.items():
+            got = NpzIO().load(s["out_path"]).weights
+            assert np.array_equal(got, _oracle_weights(p))
+        # sustained idle: the capacity windows flush, the down streak
+        # builds, the managed replica drains then stops
+        assert _tick_until(router, lambda: (
+            len(router.registry.snapshot()) == 1
+            and "stopped" in router.supervisor.managed().values()),
+            timeout_s=120.0)
+        # zero lost: every submission completed exactly once fleet-wide
+        done_delta = tracing.counters_snapshot().get(
+            "service_jobs_done", 0) - before_done
+        assert done_delta == len(jobs)
+        assert router.metrics.counter_value(
+            "fleet_scale_events_total",
+            {"direction": "down", "reason": "idle"}) >= 1
+        reasons = [b.get("reason") for b in fleet_obs.list_incidents(
+            router.incident_dir)]
+        assert "scale_down" in reasons
+        # the seed replica was never drained or stopped
+        assert svc.health()["draining"] is False
+        # the autoscaler's drain left its trace-level record
+        events = [json.loads(line) for line in
+                  telemetry.read_text().splitlines()]
+        drains = [e for e in events
+                  if e.get("event") == "fleet_drain_requested"]
+        assert drains and drains[0]["initiator"] == "autoscaler"
+        kinds = {e.get("event") for e in events}
+        assert {"fleet_scale_up", "fleet_scale_down",
+                "fleet_scale_down_complete"} <= kinds
+    finally:
+        router.stop()
+        svc.stop()
+
+
+def test_operator_drain_emits_drain_requested_event(tmp_path):
+    """The drain satellite: POST /replicas/<id>/drain leaves a
+    trace-level record (event log) of who stopped the placements."""
+    svc = _start_replica(tmp_path, "dr-op")
+    telemetry = tmp_path / "drain_events.jsonl"
+    router = _start_router(svc, telemetry=str(telemetry))
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/replicas/dr-op/drain",
+            data=json.dumps({"drain": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        reply = json.load(urllib.request.urlopen(req, timeout=30))
+        assert reply.get("draining") is True
+        events = [json.loads(line) for line in
+                  telemetry.read_text().splitlines()]
+        drains = [e for e in events
+                  if e.get("event") == "fleet_drain_requested"]
+        assert len(drains) == 1
+        assert drains[0]["replica_id"] == "dr-op"
+        assert drains[0]["drain"] is True
+        assert drains[0]["initiator"] == "operator"
+    finally:
+        router.stop()
+        svc.stop()
+
+
+def test_fleet_capacity_endpoint_strict_json(tmp_path):
+    """GET /fleet/capacity serves STRICT JSON (IEEE specials
+    stringified) with per-replica and per-bucket breakdowns."""
+    svc = _start_replica(tmp_path, "cap-a", deadline_s=3600.0,
+                         bucket_cap=8)
+    router = _start_router(svc)
+    try:
+        p = _write(tmp_path, "cap.npz", seed=44)
+        _post_job(router, {"path": p, "shape": [4, 16, 64]})
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            router.poll_tick()
+            if router.capacity.snapshot().get("fleet", {}).get("backlog"):
+                break
+            time.sleep(0.02)
+        raw = urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/fleet/capacity",
+            timeout=10).read().decode()
+        assert "Infinity" not in raw          # strict JSON, always
+        cap = json.loads(raw)
+        assert cap["fleet"]["backlog"] >= 1
+        assert cap["fleet"]["backlog_eta_s"] == "inf"   # stringified
+        assert "cap-a" in cap["replicas"]
+        assert cap["buckets"]["4x16x64"]["backlog"] >= 1
+        assert cap["autoscale"] is None       # scaling off by default
+        # and the same figure is numeric +Inf on the gauge twin
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/metrics",
+            timeout=10).read().decode()
+        assert "ict_fleet_backlog_eta_seconds +Inf" in text
+    finally:
+        router.stop()
+        svc.stop()
+
+
+def _load_fleet_top():
+    spec = importlib.util.spec_from_file_location(
+        "fleet_top", os.path.join(REPO, "tools", "fleet_top.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fleet_top_snapshot_offline(tmp_path, capsys):
+    """tools/fleet_top.py against an in-process fleet: the --json line
+    parses and carries the capacity/health halves; the table mode
+    renders every replica row; an unreachable router is rc 1."""
+    fleet_top = _load_fleet_top()
+    svc = _start_replica(tmp_path, "top-a")
+    router = _start_router(svc)
+    try:
+        router.poll_tick()
+        base = f"http://127.0.0.1:{router.port}"
+        assert fleet_top.main(["--router", base, "--json"]) == 0
+        line = capsys.readouterr().out.strip()
+        snap = json.loads(line.splitlines()[-1])
+        assert snap["router_id"] == router.router_id
+        assert snap["health"]["replicas_alive"] == 1
+        assert "fleet" in snap["capacity"]
+        assert fleet_top.main(["--router", base]) == 0
+        out = capsys.readouterr().out
+        assert "top-a" in out
+        assert "autoscale off" in out
+        assert fleet_top.main(
+            ["--router", "http://127.0.0.1:1", "--json"]) == 1
+        err_line = capsys.readouterr().out.strip()
+        assert "error" in json.loads(err_line)
+    finally:
+        router.stop()
+        svc.stop()
+
+
+def test_scale_down_victim_matched_by_url_not_reported_id(tmp_path):
+    """Regression: a spawned daemon may advertise ANY --replica_id on
+    its /healthz; victim selection must match on the supervisor's base
+    URL, or managed replicas become undrainable (the smoke's original
+    failure mode)."""
+    svc = _start_replica(tmp_path, "vic-seed")
+    factory = _serve_cfg_factory(tmp_path)
+    orig_make = factory._make_serve_cfg
+    factory._make_serve_cfg = lambda rid: type(orig_make(rid))(
+        **{**orig_make(rid).__dict__, "replica_id": f"weird-{rid}"})
+    router = _start_router(
+        svc, autoscale="act", replica_factory=factory,
+        capacity_window=2, min_replicas=1, max_replicas=2,
+        scale_up_polls=1, scale_up_eta_s=0.0,
+        scale_down_polls=2, scale_idle_util=0.5, scale_cooldown_s=0.1)
+    try:
+        paths = [_write(tmp_path, f"vic{i}.npz", seed=66 + i)
+                 for i in range(4)]
+        jobs = [_post_job(router, {"path": p, "shape": [4, 16, 64]})
+                for p in paths]
+        assert _tick_until(
+            router, lambda: len(router.registry.snapshot()) == 2)
+        assert _tick_until(router, lambda: all(
+            _get(router, f"/jobs/{j['id']}").get("state") in TERMINAL
+            for j in jobs))
+        # /fleet/capacity joins managed replicas on the ADVERTISED id so
+        # fleet_top's flags line up with the health rows
+        cap = _get(router, "/fleet/capacity")
+        assert any(rid.startswith("weird-")
+                   for rid in cap["managed_replicas"])
+        # the mismatched id must not block drain-then-stop
+        assert _tick_until(router, lambda: (
+            len(router.registry.snapshot()) == 1
+            and "stopped" in router.supervisor.managed().values()),
+            timeout_s=60.0)
+        # ...and the departed replica's scrape/straggler caches are
+        # scrubbed under the id they were keyed by (the advertised one)
+        assert not any(rid.startswith("weird-")
+                       for rid in router.scrapes.snapshot())
+    finally:
+        router.stop()
+        svc.stop()
+
+
+def test_spawn_failure_surfaces_on_scale_counter(tmp_path):
+    """A factory that cannot spawn: the act-mode scale-up retries on the
+    jitter ladder, every failure lands on
+    ict_fleet_scale_events_total{direction=up, reason=spawn_failed}, and
+    the fleet keeps serving on the seed replica."""
+
+    class _DeadFactory:
+        def spawn(self, replica_id):
+            raise OSError("no capacity anywhere")
+
+    svc = _start_replica(tmp_path, "sf-seed", deadline_s=3600.0,
+                         bucket_cap=8)
+    router = _start_router(
+        svc, autoscale="act", replica_factory=_DeadFactory(),
+        retry_backoff_s=0.001, spawn_retries=2,
+        capacity_window=2, min_replicas=1, max_replicas=2,
+        scale_up_polls=1, scale_up_eta_s=0.0, scale_cooldown_s=0.0)
+    try:
+        p = _write(tmp_path, "sf.npz", seed=55)
+        _post_job(router, {"path": p, "shape": [4, 16, 64]})
+        assert _tick_until(router, lambda: router.metrics.counter_value(
+            "fleet_scale_events_total",
+            {"direction": "up", "reason": "spawn_failed"}) >= 3)
+        # the decision itself is still recorded (reason=backlog), and no
+        # replica joined
+        assert router.metrics.counter_value(
+            "fleet_scale_events_total",
+            {"direction": "up", "reason": "backlog"}) >= 1
+        assert len(router.registry.snapshot()) == 1
+        assert router.supervisor.managed() == {}
+    finally:
+        router.stop()
+        svc.stop()

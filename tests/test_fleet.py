@@ -70,6 +70,7 @@ def _start_replica(tmp_path, tag, backend="numpy", mesh=None, **kw):
 
 
 def _start_router(*svcs, **kw):
+    factory = kw.pop("replica_factory", None)   # the autoscaler's spawner
     defaults = dict(
         replicas=tuple(f"http://127.0.0.1:{s.port}" for s in svcs),
         port=0, poll_interval_s=999.0, dead_after=2, quiet=True,
@@ -77,7 +78,7 @@ def _start_router(*svcs, **kw):
         # Hermetic: incident bundles / flight dumps never land in cwd.
         spool_dir=tempfile.mkdtemp(prefix="ict_fleet_router_"))
     defaults.update(kw)
-    router = FleetRouter(FleetConfig(**defaults))
+    router = FleetRouter(FleetConfig(**defaults), replica_factory=factory)
     router.start()
     return router
 

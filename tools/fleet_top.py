@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""fleet_top: one-shot terminal snapshot of a fleet router.
+
+``python tools/fleet_top.py --router http://host:8790`` fetches the
+router's ``/healthz``, ``GET /fleet/capacity``, and ``GET
+/fleet/metrics`` and prints one human-readable snapshot: per-replica
+state (alive/draining/dead, straggler and autoscale-managed flags,
+queue depths, utilization, service rate, dispatch p50), per-bucket
+backlog/demand/drain-ETA rows, the fleet totals, and the autoscaler
+state.  ``--json`` prints the same snapshot as ONE JSON line for
+scripting (the bench.py one-line contract).  Read-only: three GETs, no
+mutation, safe against a production router.
+
+Offline-smoke-testable: tests stand up an in-process fleet and point
+``main(["--router", url])`` at it (tests/test_autoscale.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def _get_json(base: str, route: str, timeout_s: float) -> dict:
+    with urllib.request.urlopen(f"{base}{route}", timeout=timeout_s) as resp:
+        return json.load(resp)
+
+
+def _get_text(base: str, route: str, timeout_s: float) -> str:
+    with urllib.request.urlopen(f"{base}{route}", timeout=timeout_s) as resp:
+        return resp.read().decode("utf-8")
+
+
+def collect(base: str, timeout_s: float = 10.0) -> dict:
+    """The snapshot dict both output modes render: healthz + capacity,
+    with the straggler/p50 gauges read off the router's own exposition
+    (everything fleet_top shows is an exported figure — the
+    explainability contract, docs/OBSERVABILITY.md)."""
+    from iterative_cleaner_tpu.obs import metrics as obs_metrics
+
+    health = _get_json(base, "/healthz", timeout_s)
+    capacity = _get_json(base, "/fleet/capacity", timeout_s)
+    p50s: dict[str, float] = {}
+    scale_events = 0.0
+    try:
+        fams = obs_metrics.parse_exposition(
+            _get_text(base, "/metrics", timeout_s))
+    except (OSError, ValueError):
+        fams = []
+    for fam in fams:
+        for _name, labels, raw in fam.samples:
+            d = dict(labels)
+            if fam.name == "ict_fleet_replica_p50_seconds" and "replica" in d:
+                p50s[d["replica"]] = obs_metrics.sample_value(raw)
+            elif fam.name == "ict_fleet_scale_events_total":
+                scale_events += obs_metrics.sample_value(raw)
+    return {
+        "router": base,
+        "router_id": health.get("router_id"),
+        "health": health,
+        "capacity": capacity,
+        "p50s": p50s,
+        "scale_events_total": scale_events,
+    }
+
+
+def _fmt_num(value) -> str:
+    if value is None:
+        return "-"
+    value = float(value)
+    if value == float("inf"):
+        return "inf"
+    if value == int(value) and abs(value) < 1e6:
+        return str(int(value))
+    return f"{value:.3g}"
+
+
+def render(snap: dict) -> str:
+    """The human view: replicas, buckets, fleet, autoscale — aligned
+    columns, one screen."""
+    health = snap["health"]
+    capacity = snap["capacity"]
+    caps = capacity.get("replicas", {})
+    stragglers = set(capacity.get("stragglers", []))
+    managed = capacity.get("managed_replicas", {}) or {}
+    lines = [
+        f"fleet {health.get('router_id', '?')} @ {snap['router']}  "
+        f"replicas_alive={health.get('replicas_alive')}  "
+        f"open={health.get('open_placements')}  "
+        f"queued={health.get('queued_submissions')}  "
+        f"last_poll_age_s={health.get('last_poll_age_s')}",
+        "",
+        f"{'REPLICA':<16} {'STATE':<10} {'FLAGS':<10} {'QUEUED':>6} "
+        f"{'UTIL':>6} {'RATE/S':>7} {'P50_S':>7}",
+    ]
+    for row in health.get("replicas", []):
+        rid = row.get("replica_id") or row.get("base_url", "?")
+        state = ("dead" if not row.get("alive")
+                 else "draining" if row.get("draining") else "alive")
+        flags = []
+        if rid in stragglers:
+            flags.append("strag")
+        if rid in managed:
+            flags.append("mgd")
+        cap = caps.get(rid, {})
+        queued = (float(row.get("bucketed_cubes", 0) or 0)
+                  + float(row.get("load_queue_depth", 0) or 0)
+                  + float(row.get("dispatch_queue_depth", 0) or 0))
+        lines.append(
+            f"{rid:<16} {state:<10} {','.join(flags) or '-':<10} "
+            f"{_fmt_num(queued):>6} "
+            f"{_fmt_num(cap.get('utilization')):>6} "
+            f"{_fmt_num(cap.get('service_rate')):>7} "
+            f"{_fmt_num(snap['p50s'].get(rid, cap.get('p50_s'))):>7}")
+    buckets = capacity.get("buckets", {})
+    if buckets:
+        lines += ["", f"{'BUCKET':<16} {'BACKLOG':>8} {'DEMAND/S':>9} "
+                      f"{'ETA_S':>8} {'COST_B':>10}"]
+        for bucket, rec in sorted(buckets.items()):
+            lines.append(
+                f"{bucket:<16} {_fmt_num(rec.get('backlog')):>8} "
+                f"{_fmt_num(rec.get('demand_rate')):>9} "
+                f"{_fmt_num(rec.get('eta_s')):>8} "
+                f"{_fmt_num(rec.get('cost_bytes')):>10}")
+    fleet = capacity.get("fleet", {})
+    if fleet:
+        lines += ["",
+                  f"fleet  util={_fmt_num(fleet.get('utilization'))}  "
+                  f"rate={_fmt_num(fleet.get('service_rate'))}/s  "
+                  f"demand={_fmt_num(fleet.get('demand_rate'))}/s  "
+                  f"backlog={_fmt_num(fleet.get('backlog'))}  "
+                  f"eta={_fmt_num(fleet.get('backlog_eta_s'))}s"]
+    scaler = capacity.get("autoscale")
+    if scaler:
+        last = scaler.get("last_decision") or {}
+        lines += [
+            f"autoscale mode={scaler.get('mode')}  "
+            f"bounds=[{scaler.get('min_replicas')},"
+            f"{scaler.get('max_replicas')}]  "
+            f"streaks=up:{scaler.get('up_streak')}/"
+            f"down:{scaler.get('down_streak')}  "
+            f"cooldown={_fmt_num(scaler.get('cooldown_remaining_s'))}s  "
+            f"events={_fmt_num(snap.get('scale_events_total'))}"
+            + (f"  last={last.get('direction')}:{last.get('reason')}"
+               if last else "")]
+    else:
+        lines += ["autoscale off"]
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="fleet_top",
+        description="One-shot snapshot of a fleet router's capacity view "
+                    "(/healthz + /fleet/capacity + /metrics; read-only)")
+    p.add_argument("--router", default="http://127.0.0.1:8790",
+                   metavar="URL", help="router base URL "
+                   "(default http://127.0.0.1:8790)")
+    p.add_argument("--json", action="store_true",
+                   help="one machine-readable JSON line instead of the "
+                        "terminal table")
+    p.add_argument("--timeout_s", type=float, default=10.0, metavar="S")
+    args = p.parse_args(argv)
+    base = args.router.rstrip("/")
+    try:
+        snap = collect(base, timeout_s=args.timeout_s)
+    except (urllib.error.URLError, OSError, ValueError) as exc:
+        if args.json:
+            print(json.dumps({"error": f"router unreachable: {exc}",
+                              "router": base}))
+        else:
+            print(f"error: router unreachable at {base}: {exc}",
+                  file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(snap, default=str))
+    else:
+        print(render(snap))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
