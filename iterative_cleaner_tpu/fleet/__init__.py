@@ -32,6 +32,17 @@ package puts a router process in front of N daemon replicas:
                      drain-then-stop scale-down) and the hysteresis +
                      cooldown Autoscaler behind ``--autoscale
                      advise|act``
+- :mod:`.history`  — the bounded federated-metrics history ring: one
+                     parsed exposition per poll tick (zero new scrape
+                     traffic), lossless strict-JSON ticks at
+                     ``GET /fleet/metrics/history``, the windowed
+                     series the alert predicates evaluate over
+- :mod:`.alerts`   — the declarative alerting plane: SLO rules
+                     ``(name, severity, selector, predicate,
+                     for_ticks)`` over the history, a firing->resolved
+                     state machine with per-rule hysteresis, on-disk
+                     firing bundles, webhook/command sinks, and the
+                     default rule pack behind ``GET /fleet/alerts``
 
 The router is routing, not math: every mask is produced by a replica,
 and replicas stay bit-identical to the numpy oracle on every route

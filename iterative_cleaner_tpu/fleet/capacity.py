@@ -184,7 +184,7 @@ class CapacityModel:
                 rate = sum(dwin) / wall if wall > 0 else 0.0
                 cum = fleet_obs.phase_hist_cum(families,
                                                self.dispatch_phase)
-                p50 = fleet_obs.histogram_quantile(cum, 0.5)
+                p50 = obs_metrics.quantile_from_cum(cum, 0.5)
                 queued = (float(row.get("bucketed_cubes", 0) or 0)
                           + float(row.get("load_queue_depth", 0) or 0)
                           + float(row.get("dispatch_queue_depth", 0) or 0))

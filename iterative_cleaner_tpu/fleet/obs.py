@@ -179,43 +179,19 @@ def phase_hist_cum(families: list[MetricFamily], phase: str,
                    ) -> dict[float, float]:
     """Cumulative latency-bucket counts (``le`` bound -> count) for one
     phase out of a parsed scrape's ``ict_phase_duration_seconds`` family;
-    empty when the replica has not observed the phase yet."""
-    out: dict[float, float] = {}
-    for fam in families:
-        if fam.name != "ict_phase_duration_seconds":
-            continue
-        for name, labels, raw in fam.samples:
-            if not name.endswith("_bucket"):
-                continue
-            d = dict(labels)
-            if d.get("phase") != phase:
-                continue
-            try:
-                # The label grammar does not constrain `le` to a number;
-                # a foreign bound must be skipped, not kill the poll
-                # thread that called us.
-                out[obs_metrics.sample_value(d.get("le", "+Inf"))] = (
-                    obs_metrics.sample_value(raw))
-            except ValueError:
-                continue
-    return out
+    empty when the replica has not observed the phase yet.  Thin wrapper
+    over the shared :func:`obs.metrics.bucket_cum` (foreign ``le`` bounds
+    are skipped, never raised out of the poll thread)."""
+    return obs_metrics.bucket_cum(families, "ict_phase_duration_seconds",
+                                  {"phase": phase})
 
 
 def histogram_quantile(cum: dict[float, float], q: float) -> float | None:
-    """Upper-bound quantile estimate from cumulative bucket counts: the
-    smallest ``le`` whose cumulative count reaches ``q`` of the total.
-    None when the histogram is empty."""
-    if not cum:
-        return None
-    bounds = sorted(cum)
-    total = cum[bounds[-1]]
-    if total <= 0:
-        return None
-    target = q * total
-    for bound in bounds:
-        if cum[bound] >= target:
-            return bound
-    return bounds[-1]
+    """Back-compat alias for the ONE shared upper-bound-bucket estimator,
+    :func:`obs.metrics.quantile_from_cum` — the straggler detector, the
+    capacity model, and the alert engine's quantile predicates must never
+    disagree about the same scrape."""
+    return obs_metrics.quantile_from_cum(cum, q)
 
 
 class ScrapeCache:
@@ -375,7 +351,7 @@ class StragglerDetector:
                         summed[le] = summed.get(le, 0.0) + n
                 total = max(summed.values()) if summed else 0.0
                 if total >= self.min_count:
-                    q = histogram_quantile(summed, 0.5)
+                    q = obs_metrics.quantile_from_cum(summed, 0.5)
                     if q is not None:
                         p50[rid] = q
             median = None

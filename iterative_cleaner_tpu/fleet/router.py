@@ -44,13 +44,16 @@ import os
 import sys
 import threading
 import time
+import urllib.parse
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from iterative_cleaner_tpu.fleet import alerts as fleet_alerts
 from iterative_cleaner_tpu.fleet import autoscale as fleet_autoscale
 from iterative_cleaner_tpu.fleet import capacity as fleet_capacity
+from iterative_cleaner_tpu.fleet import history as fleet_history
 from iterative_cleaner_tpu.fleet import obs as fleet_obs
 from iterative_cleaner_tpu.fleet.client import (
     ReplicaClient,
@@ -145,6 +148,19 @@ class FleetConfig:
     spawn_retries: int = 3           # full-jitter spawn retry ladder depth
     spawn_args: tuple = ()           # extra ict-serve args for spawned
                                      # subprocess replicas (--spawn_arg)
+    history_ticks: int = 128         # poll ticks of federated-metrics
+                                     # history retained (fleet/history.py;
+                                     # GET /fleet/metrics/history)
+    default_alerts: bool = True      # install the default SLO rule pack
+                                     # (fleet/alerts.py)
+    alert_rules: tuple = ()          # extra rule specs (dicts, the
+                                     # --alert_rule JSON shape) on top of
+                                     # the default pack
+    alert_webhook: str = ""          # POST each firing/resolved
+                                     # transition here (full-jitter retry)
+    alert_cmd: str = ""              # shell command per transition
+                                     # (the JSON on stdin)
+    alert_retries: int = 3           # delivery retries per sink
     quiet: bool = False
 
 
@@ -322,6 +338,36 @@ class FleetRouter:
         self.capacity = fleet_capacity.CapacityModel(
             window=cfg.capacity_window,
             dispatch_phase=cfg.straggler_phase)
+        # The alerting plane (ISSUE 12): the bounded federated-metrics
+        # history ring fed once per poll tick from the exposition the
+        # router already serves (zero new scrape traffic), and the
+        # declarative rule engine evaluated over it.  Both own their own
+        # locks, acquired strictly AFTER the router's RLock and never
+        # while calling out — the router -> obs/capacity order extends to
+        # history/alerts unchanged.
+        self.history = fleet_history.MetricsHistory(keep=cfg.history_ticks)
+        rules: list[fleet_alerts.AlertRule] = []
+        if cfg.default_alerts:
+            rules.extend(fleet_alerts.default_rule_pack(
+                poll_interval_s=cfg.poll_interval_s,
+                scale_up_eta_s=cfg.scale_up_eta_s,
+                autoscale=cfg.autoscale))
+        for spec in cfg.alert_rules:
+            rule = (spec if isinstance(spec, fleet_alerts.AlertRule)
+                    else fleet_alerts.parse_rule(spec))
+            # An operator rule re-using a default name REPLACES the
+            # default (how a threshold is tuned without --no_default_alerts).
+            rules = [r for r in rules if r.name != rule.name]
+            rules.append(rule)
+        self.alerts = fleet_alerts.AlertEngine(
+            rules, history_ticks=cfg.history_ticks)
+        self.alert_sinks = fleet_alerts.AlertSinks(
+            webhook=cfg.alert_webhook, command=cfg.alert_cmd,
+            retries=cfg.alert_retries,
+            retry_backoff_s=cfg.retry_backoff_s, quiet=cfg.quiet,
+            note=lambda sink, status: self.metrics.count(
+                "fleet_alert_notifications_total",
+                {"sink": sink, "status": status}))
         # The elastic-scaling loop (fleet/autoscale.py), off by default.
         # The supervisor spawns in-process replicas when the embedder
         # hands in a factory (tests, the autoscale smoke) and real
@@ -387,6 +433,10 @@ class FleetRouter:
     def incident_dir(self) -> str:
         return os.path.join(self.cfg.spool_dir, "fleet-incidents")
 
+    @property
+    def alert_dir(self) -> str:
+        return os.path.join(self.cfg.spool_dir, "fleet-alerts")
+
     # --- lifecycle ---
 
     def start(self) -> None:
@@ -427,6 +477,7 @@ class FleetRouter:
             # Managed replicas die with their router (their spools keep
             # any unfinished accepted work for the next life).
             self.supervisor.stop_all()
+        self.alert_sinks.stop()
         self._stop_evt.set()
         with self._lock:
             self._cond.notify_all()
@@ -470,6 +521,7 @@ class FleetRouter:
         self._update_replica_gauges()
         self._update_capacity()
         self._autoscale_tick()
+        self._history_alert_tick()
         self._trim_placements()
         with self._lock:
             self._last_poll_mono = time.monotonic()
@@ -784,6 +836,7 @@ class FleetRouter:
             rid = rec["replica_id"]
             self.scrapes.forget(rid)
             self.straggler.forget(rid)
+            self.alerts.forget(rid)
             with self._lock:
                 self._health_seen.pop(rid, None)
             if events.active():
@@ -950,6 +1003,71 @@ class FleetRouter:
             events.emit("fleet_incident", reason=reason,
                         replica_id=decision.get("replica_id", ""),
                         bundle=path or "")
+
+    def _history_alert_tick(self) -> None:
+        """One tick of the alerting plane: append the CURRENT federated
+        exposition (router registry + cached per-replica series + merged
+        families — the exact ``GET /fleet/metrics`` body, built from the
+        snapshots this tick already took, so alert evaluation never adds
+        scrape traffic) to the history ring, evaluate every rule over the
+        ring, and fan out each transition — counter, gauge, event log,
+        flight ring, on-disk bundle (firings), webhook/command sinks.
+
+        The render-then-parse round trip is deliberate, not an
+        oversight: it guarantees the history records EXACTLY what a
+        scraper of ``GET /fleet/metrics`` would parse (same family
+        grouping, same collision semantics, one grammar implementation)
+        at the cost of re-tokenizing one exposition per tick — a few ms
+        at fleet scale, on the poll thread's 1 s cadence."""
+        families = obs_metrics.parse_exposition(self.fleet_metrics())
+        self.history.append(families)
+        verdict = self.alerts.evaluate(self.history)
+        for alert in verdict["fired"]:
+            self.metrics.count("fleet_alerts_total",
+                               {"rule": alert["rule"],
+                                "severity": alert["severity"]})
+            if events.active():
+                events.emit("fleet_alert_firing", rule=alert["rule"],
+                            severity=alert["severity"],
+                            labels=alert["labels"], value=alert["value"])
+            flight.note("fleet_alert_firing", rule=alert["rule"],
+                        severity=alert["severity"], labels=alert["labels"])
+            # The firing bundle: the rule, the evaluated samples, and the
+            # history window that fired it — reconstructible from disk.
+            window = int(alert["predicate"].get("window", 1)) + 1
+            rule = next((r for r in self.alerts.rules
+                         if r.name == alert["rule"]), None)
+            bundle = fleet_alerts.write_alert_bundle(
+                self.alert_dir, alert=alert,
+                rule=rule.to_json() if rule else {},
+                window=self.history.to_json(ticks=window)["ticks"])
+            if not self.cfg.quiet:
+                print(f"ict-fleet: ALERT {alert['severity']} "
+                      f"{alert['rule']} firing "
+                      f"({alert['labels'] or 'fleet'}; "
+                      f"value {alert['value']}"
+                      f"{'; bundle ' + bundle if bundle else ''})",
+                      file=sys.stderr)
+            self.alert_sinks.notify(alert)
+        for alert in verdict["resolved"]:
+            if events.active():
+                events.emit("fleet_alert_resolved", rule=alert["rule"],
+                            severity=alert["severity"],
+                            labels=alert["labels"], value=alert["value"])
+            flight.note("fleet_alert_resolved", rule=alert["rule"],
+                        severity=alert["severity"], labels=alert["labels"])
+            if not self.cfg.quiet:
+                print(f"ict-fleet: alert {alert['rule']} resolved "
+                      f"({alert['labels'] or 'fleet'})", file=sys.stderr)
+            self.alert_sinks.notify(alert)
+        # Firing gauge: rebuilt whole per tick (resolution reads as 0,
+        # not absence).  It lands in the NEXT tick's history record —
+        # the gauge describes the ring, so it cannot also be inside the
+        # tick it describes.
+        self.metrics.replace_gauge_family(
+            "fleet_alerts_firing",
+            {(("rule", name),): float(n)
+             for name, n in self.alerts.firing_counts().items()})
 
     def _trim_placements(self) -> None:
         """Bound the placement table by evicting the oldest TERMINAL
@@ -1326,6 +1444,29 @@ class FleetRouter:
         snap["managed_replicas"] = managed
         return snap
 
+    def fleet_alerts(self) -> dict:
+        """``GET /fleet/alerts``: the firing set, the rule table (with
+        per-rule firing-series counts), the recent firing/resolved
+        transitions, and the on-disk bundle inventory — strict JSON, the
+        ``/fleet/capacity`` IEEE-specials discipline."""
+        return _json_safe({
+            "firing": self.alerts.firing(),
+            "rules": self.alerts.rules_table(),
+            "recent": self.alerts.recent(),
+            "bundles": fleet_alerts.list_alert_bundles(self.alert_dir),
+            "history_ticks": self.history.size(),
+            "sinks": {"webhook": bool(self.cfg.alert_webhook),
+                      "cmd": bool(self.cfg.alert_cmd)},
+        })
+
+    def fleet_metrics_history(self, ticks: int | None = None) -> dict:
+        """``GET /fleet/metrics/history``: the bounded ring of per-tick
+        federated expositions, lossless (each tick's families re-render
+        byte-exact).  Sample values are the exposition's raw strings —
+        ``+Inf``/``NaN`` spellings included — so the reply stays strict
+        JSON with no IEEE specials to stringify."""
+        return self.history.to_json(ticks=ticks)
+
     def fleet_trace(self, trace_id: str) -> tuple[int, dict]:
         """``GET /fleet/trace/<id>``: one stitched cross-hop timeline.
 
@@ -1436,6 +1577,19 @@ class FleetRouter:
                 self.capacity.snapshot().get("fleet", {})),
             "autoscale": (self.autoscaler.state()
                           if self.autoscaler is not None else None),
+            # The alerting plane's firing summary (ISSUE 12): enough for
+            # a load balancer or fleet_top to see "something is firing"
+            # without a second request; GET /fleet/alerts has the rest.
+            "alerts": self._alerts_summary(),
+        }
+
+    def _alerts_summary(self) -> dict:
+        firing = self.alerts.firing()
+        return {
+            "firing": len(firing),
+            "critical": sum(1 for a in firing
+                            if a["severity"] == "critical"),
+            "rules": sorted({a["rule"] for a in firing}),
         }
 
     def drain_replica(self, replica_id: str, flag: bool) -> tuple[int, dict]:
@@ -1511,6 +1665,20 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path.split("?", 1)[0] == "/fleet/metrics/history":
+            query = urllib.parse.parse_qs(
+                urllib.parse.urlsplit(self.path).query)
+            try:
+                ticks = int(query["ticks"][0]) if "ticks" in query else None
+            except ValueError:
+                ticks = -1
+            if ticks is not None and ticks < 0:
+                self._reply(400, {"error": "bad ?ticks= value; want an "
+                                           "int >= 0"})
+                return
+            self._reply(200, router.fleet_metrics_history(ticks=ticks))
+        elif self.path == "/fleet/alerts":
+            self._reply(200, router.fleet_alerts())
         elif self.path == "/fleet/capacity":
             self._reply(200, router.fleet_capacity())
         elif self.path.startswith("/fleet/trace/"):
@@ -1713,6 +1881,42 @@ def build_fleet_parser() -> argparse.ArgumentParser:
                    help="extra ict-serve argument for autoscaler-spawned "
                         "subprocess replicas (repeatable), e.g. "
                         "--spawn_arg=--backend=numpy")
+    p.add_argument("--history_ticks", type=int, default=128, metavar="N",
+                   help="poll ticks of federated-metrics history retained "
+                        "and served at GET /fleet/metrics/history; the "
+                        "alert predicates evaluate over this ring "
+                        "(default 128)")
+    p.add_argument("--alert_rule", action="append", default=[],
+                   metavar="JSON",
+                   help="one declarative alert rule as a JSON object "
+                        '(repeatable), e.g. \'{"name": "hot", "severity": '
+                        '"warning", "family": '
+                        '"ict_fleet_backlog_eta_seconds", "predicate": '
+                        '{"op": "gt", "value": 30}, "for_ticks": 3}\'; a '
+                        "rule re-using a default-pack name replaces that "
+                        'default (docs/OBSERVABILITY.md "Alerting & '
+                        'history")')
+    p.add_argument("--alert_rules", default="", metavar="PATH",
+                   help="JSON file holding a list of alert-rule objects "
+                        "(same shape as --alert_rule), applied after the "
+                        "default pack")
+    p.add_argument("--no_default_alerts", action="store_true",
+                   help="do not install the default SLO rule pack (audit "
+                        "divergence, scrape staleness, unscaled backlog, "
+                        "backend demotion, spool disk, compile-cache "
+                        "thrash)")
+    p.add_argument("--alert_webhook", default="", metavar="URL",
+                   help="POST each alert firing/resolved transition to "
+                        "URL as JSON (full-jitter retries; delivery "
+                        "outcomes on "
+                        "ict_fleet_alert_notifications_total)")
+    p.add_argument("--alert_cmd", default="", metavar="CMD",
+                   help="run CMD (a shell command) per alert transition "
+                        "with the JSON on stdin — the pager/hook shape "
+                        "(full-jitter retries, 10 s timeout)")
+    p.add_argument("--alert_retries", type=int, default=3, metavar="N",
+                   help="full-jitter delivery retries per alert sink "
+                        "(default 3)")
     p.add_argument("-q", "--quiet", action="store_true")
     p.add_argument("--smoke", action="store_true",
                    help="offline self-check: 2 in-process replicas behind "
@@ -1775,6 +1979,34 @@ def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
     if args.scale_cooldown_s < 0:
         raise ValueError(f"--scale_cooldown_s must be >= 0, got "
                          f"{args.scale_cooldown_s}")
+    if args.history_ticks < 1:
+        raise ValueError(f"--history_ticks must be >= 1, got "
+                         f"{args.history_ticks}")
+    if args.alert_retries < 0:
+        raise ValueError(f"--alert_retries must be >= 0, got "
+                         f"{args.alert_retries}")
+    alert_rules: list[dict] = []
+    for raw in args.alert_rule:
+        try:
+            spec = json.loads(raw)
+        except ValueError as exc:
+            raise ValueError(f"bad --alert_rule JSON {raw!r}: {exc}"
+                             ) from None
+        fleet_alerts.parse_rule(spec)   # validate NOW, at the CLI surface
+        alert_rules.append(spec)
+    if args.alert_rules:
+        try:
+            with open(args.alert_rules) as fh:
+                file_rules = json.load(fh)
+        except (OSError, ValueError) as exc:
+            raise ValueError(f"cannot read --alert_rules "
+                             f"{args.alert_rules!r}: {exc}") from None
+        if not isinstance(file_rules, list):
+            raise ValueError(f"--alert_rules {args.alert_rules!r} must "
+                             "hold a JSON list of rule objects")
+        for spec in file_rules:
+            fleet_alerts.parse_rule(spec)
+            alert_rules.append(spec)
     quotas, weights = parse_tenant_specs(args.tenant)
     return FleetConfig(
         replicas=tuple(args.replica),
@@ -1809,6 +2041,12 @@ def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
         scale_cooldown_s=args.scale_cooldown_s,
         spawn_retries=args.spawn_retries,
         spawn_args=tuple(args.spawn_arg),
+        history_ticks=args.history_ticks,
+        default_alerts=not args.no_default_alerts,
+        alert_rules=tuple(alert_rules),
+        alert_webhook=args.alert_webhook,
+        alert_cmd=args.alert_cmd,
+        alert_retries=args.alert_retries,
         quiet=args.quiet,
     )
 
@@ -1919,6 +2157,15 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
             # Hermetic: incident bundles and flight dumps land in the
             # smoke's own tempdir, never the operator's spool.
             "spool_dir": os.path.join(tmp, "router_spool"),
+            # The alerts lane (ISSUE 12): a tiny-threshold injected rule
+            # that MUST fire while placements are open and resolve once
+            # the fleet drains — one full firing -> resolved lifecycle
+            # cycle, asserted below alongside the operator's own rules.
+            "alert_rules": tuple(cfg.alert_rules) + ({
+                "name": "smoke_open_placements", "severity": "info",
+                "family": "ict_fleet_open_placements",
+                "predicate": {"op": "gt", "value": 0}, "for_ticks": 1,
+                "description": "serve-fleet --smoke injected rule"},),
         }))
         router.start()
         jobs = {}
@@ -2010,9 +2257,54 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                     break
             incidents = json.load(urllib.request.urlopen(
                 f"{base}/fleet/incidents", timeout=10))["incidents"]
+            # --- the alerting plane, end to end (ISSUE 12) ---
+            # The injected rule fired while placements were open; with
+            # every job terminal, drive ticks until it resolves (bounded
+            # — the background loop may already have).
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                router.poll_tick()
+                if not any(a["rule"] == "smoke_open_placements"
+                           for a in router.alerts.firing()):
+                    break
+                time.sleep(0.05)
+            alerts_view = json.load(urllib.request.urlopen(
+                f"{base}/fleet/alerts", timeout=10))
+            cycle = [t["state"] for t in alerts_view["recent"]
+                     if t["rule"] == "smoke_open_placements"]
+            alert_fired = router.metrics.counter_value(
+                "fleet_alerts_total", {"rule": "smoke_open_placements",
+                                       "severity": "info"})
+            # The counter must be VISIBLE through the federated scrape,
+            # and the history endpoint must serve re-renderable ticks.
+            alert_text = urllib.request.urlopen(
+                f"{base}/fleet/metrics", timeout=10).read().decode()
+            counter_visible = False
+            try:
+                for fam in obs_metrics.parse_exposition(alert_text):
+                    if fam.name != "ict_fleet_alerts_total":
+                        continue
+                    for _n, labels, raw in fam.samples:
+                        if (dict(labels).get("rule")
+                                == "smoke_open_placements"
+                                and obs_metrics.sample_value(raw) >= 1):
+                            counter_visible = True
+            except ValueError:
+                pass
+            history_view = json.load(urllib.request.urlopen(
+                f"{base}/fleet/metrics/history?ticks=4", timeout=10))
+            bundles = fleet_alerts.list_alert_bundles(router.alert_dir)
+            alerts_ok = (alert_fired >= 1 and counter_visible
+                         and cycle[:2] == ["firing", "resolved"]
+                         and not any(a["rule"] == "smoke_open_placements"
+                                     for a in alerts_view["firing"])
+                         and any(b.get("rule") == "smoke_open_placements"
+                                 for b in bundles)
+                         and len(history_view["ticks"]) >= 1)
             ok = (all_done and masks_ok and failovers >= 1
                   and done_delta == len(paths)
                   and fleet_ok and trace_ok and len(incidents) >= 1
+                  and alerts_ok
                   and health_b.get("audits_run", 0) >= 1
                   and health_b.get("audit_divergences", 0) == 0)
             result = {
@@ -2026,6 +2318,10 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                 "fleet_metrics_merged_ok": bool(fleet_ok),
                 "stitched_trace_ok": bool(trace_ok),
                 "incident_bundles": len(incidents),
+                "alerts_lane_ok": bool(alerts_ok),
+                "alerts_fired": int(alert_fired),
+                "alert_bundles": len(bundles),
+                "history_ticks": len(history_view["ticks"]),
                 "audits_run": health_b.get("audits_run", 0),
                 "audit_divergences": health_b.get("audit_divergences", 0),
                 "placements": {
@@ -2257,7 +2553,6 @@ def fleet_main(argv: list[str] | None = None) -> int:
         return run_fleet_smoke(cfg)
     try:
         router = FleetRouter(cfg)
-        router.start()
     except (RuntimeError, OSError, ValueError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1
@@ -2265,7 +2560,9 @@ def fleet_main(argv: list[str] | None = None) -> int:
     # stop — the same handler shape as serve_main: "what was the router
     # doing when the orchestrator killed it" becomes a file under
     # <spool>/flight instead of a guess (docs/OBSERVABILITY.md "Fleet
-    # observability").
+    # observability").  Installed BEFORE start(): an orchestrator that
+    # signals the moment the startup line appears must hit the handler,
+    # not the default disposition (the window used to lose rare races).
     import signal
 
     def _on_stop_signal(signum, frame):
@@ -2282,6 +2579,11 @@ def fleet_main(argv: list[str] | None = None) -> int:
             signal.signal(sig, _on_stop_signal)
         except (ValueError, OSError):  # noqa: PERF203 — non-main-thread embed
             pass
+    try:
+        router.start()
+    except (RuntimeError, OSError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
     try:
         while True:
             time.sleep(3600)

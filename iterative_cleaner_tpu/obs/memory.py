@@ -25,6 +25,7 @@ every device read first checks that a backend is already live.
 from __future__ import annotations
 
 import os
+import shutil
 
 from iterative_cleaner_tpu.obs import tracing
 
@@ -163,6 +164,20 @@ def update_process_gauges() -> None:
             if rec["bytes_limit"]:
                 tracing.set_gauge_labeled("hbm_bytes_limit", labels,
                                           float(rec["bytes_limit"]))
+    except Exception:  # noqa: BLE001 — gauges are best-effort
+        pass
+
+
+def update_spool_gauge(spool_dir: str) -> None:
+    """Export the spool volume's free bytes as the
+    ``ict_spool_disk_free_bytes`` gauge — the figure the fleet alert
+    pack's ``spool_disk_low`` rule watches (a daemon whose spool volume
+    fills starts failing manifest writes, the
+    ``service_spool_save_errors`` alarm's *leading* indicator).  Never
+    raises; a missing directory just leaves the gauge unset."""
+    try:
+        tracing.set_gauge("spool_disk_free_bytes",
+                          float(shutil.disk_usage(spool_dir or ".").free))
     except Exception:  # noqa: BLE001 — gauges are best-effort
         pass
 

@@ -300,3 +300,57 @@ def sample_value(raw: str) -> float:
     if raw == "-Inf":
         return float("-inf")
     return float(raw)
+
+
+# --- shared histogram-bucket math (the one quantile estimator) ---
+#
+# The straggler detector (fleet/obs.py), the capacity model
+# (fleet/capacity.py), and the alert engine's rate/quantile predicates
+# (fleet/alerts.py) all estimate quantiles off the same fixed-bound
+# cumulative bucket counts.  One estimator, one set of edge-case tests
+# (tests/test_fleet_alerts.py) — a drifted second implementation would
+# make two layers disagree about the same scrape.
+
+
+def bucket_cum(families: list[MetricFamily], family: str,
+               labels: dict[str, str] | None = None) -> dict[float, float]:
+    """Cumulative bucket counts (``le`` bound -> count) for one histogram
+    family out of a parsed scrape, filtered to samples whose label pairs
+    contain every ``labels`` entry; empty when nothing matches.
+
+    A grammar-valid scrape may still carry a foreign (non-numeric) ``le``
+    bound — skipped, never raised, so the poll/alert threads that call
+    this survive any replica's exposition."""
+    want = dict(labels or {})
+    out: dict[float, float] = {}
+    for fam in families:
+        if fam.name != family:
+            continue
+        for name, label_pairs, raw in fam.samples:
+            if not name.endswith("_bucket"):
+                continue
+            d = dict(label_pairs)
+            if any(d.get(k) != v for k, v in want.items()):
+                continue
+            try:
+                out[sample_value(d.get("le", "+Inf"))] = sample_value(raw)
+            except ValueError:
+                continue
+    return out
+
+
+def quantile_from_cum(cum: dict[float, float], q: float) -> float | None:
+    """Upper-bound quantile estimate from cumulative bucket counts: the
+    smallest ``le`` whose cumulative count reaches ``q`` of the total.
+    None when the histogram is empty or its total is non-positive."""
+    if not cum:
+        return None
+    bounds = sorted(cum)
+    total = cum[bounds[-1]]
+    if total <= 0:
+        return None
+    target = q * total
+    for bound in bounds:
+        if cum[bound] >= target:
+            return bound
+    return bounds[-1]

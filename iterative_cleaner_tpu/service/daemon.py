@@ -46,6 +46,10 @@ from iterative_cleaner_tpu.service.worker import DispatchWorker
 
 _STOP = object()
 
+#: Serializes the loader pool's one-time lazy `import jax` chain — see
+#: the comment in :meth:`CleaningService._load_loop`.
+_LOADER_IMPORT_LOCK = threading.Lock()
+
 
 @dataclass
 class ServeConfig:
@@ -280,6 +284,15 @@ class CleaningService:
         # documents).
         from iterative_cleaner_tpu.obs.audit import ShadowAuditor
 
+        # Pre-register the correctness-health counters at 0 so they are
+        # PRESENT on the exposition from the first scrape.  The fleet's
+        # critical alert rules (audit_divergence, backend_demoted) are
+        # gt-0 thresholds over these series; a lazily-registered counter
+        # would vanish across a clean restart and freeze-on-missing
+        # would pin an already-fired alert forever instead of resolving
+        # it against the restarted replica's explicit 0.
+        tracing.count("audit_divergences", 0)
+        tracing.count("service_backend_demotions", 0)
         self.ctx.auditor = ShadowAuditor(
             self.spool, self.repro_dir,
             on_divergence=self.ctx.note_audit_divergence,
@@ -506,7 +519,18 @@ class CleaningService:
     # --- internals ---
 
     def _load_loop(self) -> None:
-        from iterative_cleaner_tpu.parallel.batch import _load_and_preprocess
+        # Serialized deliberately: with loaders >= 2, the pool's threads
+        # race the FIRST `import jax` chain here, and CPython's
+        # circular-import deadlock avoidance can hand a loser a
+        # partially-initialized module — both loader threads then die at
+        # startup and every future job wedges in the load queue (observed
+        # on a fresh `ict-serve --backend numpy` subprocess).  After the
+        # winner finishes, the import is a sys.modules hit; laziness is
+        # kept so an idle numpy-mode daemon still never imports jax.
+        with _LOADER_IMPORT_LOCK:
+            from iterative_cleaner_tpu.parallel.batch import (
+                _load_and_preprocess,
+            )
 
         while True:
             job = self._load_q.get()
@@ -533,6 +557,11 @@ class CleaningService:
             if now - last_gauges >= 2.0:
                 last_gauges = now
                 obs_memory.update_process_gauges()
+                # Spool disk headroom rides the same cadence — the fleet
+                # alert pack's spool_disk_low rule reads it off the
+                # federated scrape (docs/OBSERVABILITY.md "Alerting &
+                # history").
+                obs_memory.update_spool_gauge(self.serve_cfg.spool_dir)
 
     def _on_flush(self, entries) -> None:
         tracing.count("service_buckets_dispatched")

@@ -143,10 +143,26 @@ def _backend_liveness() -> str:
     ensure_responsive_backend skips only on a definite "live" — wedge
     protection survives drift), while pin_cpu_backend must NOT retarget a
     possibly-live process (so it acts only on a definite "not_live").
-    Prefers the public-ish ``xla_bridge.backends_are_initialized()``."""
-    try:
-        from jax._src import xla_bridge as _xb
+    Prefers the public-ish ``xla_bridge.backends_are_initialized()``.
 
+    Reads ``sys.modules`` instead of importing: a process that never
+    imported jax cannot have a live backend, and an IMPORT here is an
+    active hazard — this check runs from watchdog/metrics threads, and a
+    ``from jax._src import xla_bridge`` racing another thread's first
+    ``import jax`` forms exactly the lock cycle CPython's circular-import
+    deadlock avoidance breaks by exposing partially-initialized modules
+    (observed killing a fresh daemon's loader pool).  The liveness guard
+    exists so observability never touches the backend; that must include
+    never *importing* it."""
+    try:
+        if "jax" not in sys.modules:
+            return "not_live"   # jax never imported -> no backend, definite
+        _xb = sys.modules.get("jax._src.xla_bridge")
+        if _xb is None:
+            # jax is imported but the private module path is gone (layout
+            # drift — or mid-import in another thread): NOT a definite
+            # "not_live"; pin_cpu_backend must never retarget on drift.
+            return "unknown"
         fn = getattr(_xb, "backends_are_initialized", None)
         if fn is not None:
             return "live" if fn() else "not_live"

@@ -131,3 +131,30 @@ class TestLivenessDrift:
         assert os.environ["JAX_PLATFORMS"] == "axon"  # pin declined
         err = capsys.readouterr().err
         assert "NOT applied" in err and "may hang" in err
+
+
+class TestLivenessNeverImports:
+    """The liveness check must READ state, never import jax: a
+    `from jax._src import xla_bridge` racing another thread's first
+    `import jax` forms the lock cycle CPython's deadlock avoidance
+    breaks by exposing partially-initialized modules (it killed a fresh
+    daemon's loader pool).  sys.modules is the whole input now."""
+
+    def test_no_jax_in_sys_modules_is_definitely_not_live(self, monkeypatch):
+        import sys
+
+        monkeypatch.delitem(sys.modules, "jax", raising=False)
+        assert device_probe._backend_liveness() == "not_live"
+
+    def test_missing_private_module_is_unknown_not_not_live(
+            self, monkeypatch):
+        """Layout drift (jax imported, jax._src.xla_bridge relocated)
+        must read as "unknown": pin_cpu_backend acts only on a definite
+        "not_live", and retargeting a possibly-live backend is the exact
+        hazard the tri-state exists to prevent."""
+        import sys
+
+        assert "jax" in sys.modules
+        monkeypatch.delitem(sys.modules, "jax._src.xla_bridge",
+                            raising=False)
+        assert device_probe._backend_liveness() == "unknown"

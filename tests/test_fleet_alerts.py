@@ -1,0 +1,809 @@
+"""ict-fleet-alerts: the declarative alerting plane (ISSUE 12).
+
+Units: the ONE shared quantile estimator's edge cases
+(obs.metrics.quantile_from_cum / bucket_cum — the straggler layer and
+the alert predicates must never disagree), the bounded MetricsHistory
+ring with byte-exact per-tick re-rendering (+Inf/NaN spellings and
+escaped label values included), the rule grammar's validation, every
+predicate op, the firing→resolved state machine with for_ticks
+hysteresis and missing-series freeze, the default rule pack, alert
+bundles' atomic write + retention, and the webhook/command sinks'
+full-jitter retry.  End to end: a router with an injected
+tiny-threshold rule fires on a poll tick (counter + gauge + event +
+bundle + /fleet/alerts + /healthz summary), resolves when the signal
+clears, and GET /fleet/metrics/history serves lossless ticks — with
+alert evaluation running ONLY on the poll-tick snapshot (no per-rule
+scrapes, pinned by construction: the engine reads the history ring).
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from test_fleet import (
+    _get,
+    _start_replica,
+    _start_router,
+)
+from test_observability import _parse_prometheus
+from iterative_cleaner_tpu.fleet import alerts as fleet_alerts
+from iterative_cleaner_tpu.fleet import history as fleet_history
+from iterative_cleaner_tpu.fleet import obs as fleet_obs
+from iterative_cleaner_tpu.fleet.alerts import (
+    AlertEngine,
+    AlertSinks,
+    MAX_ALERT_BUNDLES_KEPT,
+    default_rule_pack,
+    parse_rule,
+)
+from iterative_cleaner_tpu.fleet.history import MetricsHistory
+from iterative_cleaner_tpu.obs import metrics as obs_metrics
+
+
+# --- the shared quantile estimator (satellite: one estimator) ---
+
+
+class TestQuantileFromCum:
+    def test_empty_and_nonpositive_totals_are_none(self):
+        assert obs_metrics.quantile_from_cum({}, 0.5) is None
+        assert obs_metrics.quantile_from_cum({0.1: 0.0, 1.0: 0.0},
+                                             0.5) is None
+        assert obs_metrics.quantile_from_cum({1.0: -3.0}, 0.5) is None
+
+    def test_upper_bound_semantics(self):
+        cum = {0.001: 2.0, 0.01: 5.0, 0.1: 9.0, float("inf"): 10.0}
+        assert obs_metrics.quantile_from_cum(cum, 0.5) == 0.01
+        assert obs_metrics.quantile_from_cum(cum, 0.2) == 0.001
+        assert obs_metrics.quantile_from_cum(cum, 0.9) == 0.1
+        assert obs_metrics.quantile_from_cum(cum, 0.95) == float("inf")
+        # q=1.0 lands on the last bound that covers the total
+        assert obs_metrics.quantile_from_cum(cum, 1.0) == float("inf")
+
+    def test_single_bucket_and_boundary_targets(self):
+        assert obs_metrics.quantile_from_cum({0.5: 7.0}, 0.5) == 0.5
+        # target exactly equal to a cumulative count picks that bound
+        cum = {1.0: 5.0, 2.0: 10.0}
+        assert obs_metrics.quantile_from_cum(cum, 0.5) == 1.0
+
+    def test_straggler_layer_uses_the_shared_estimator(self):
+        """fleet_obs.histogram_quantile is the same function — the
+        back-compat alias must not drift into a second implementation."""
+        cum = {0.01: 3.0, 1.0: 6.0, float("inf"): 6.0}
+        assert (fleet_obs.histogram_quantile(cum, 0.5)
+                == obs_metrics.quantile_from_cum(cum, 0.5) == 0.01)
+
+
+class TestBucketCum:
+    def test_filters_by_label_subset_and_skips_foreign_le(self):
+        fam = obs_metrics.MetricFamily(
+            name="ict_phase_duration_seconds", kind="histogram")
+        fam.samples += [
+            ("ict_phase_duration_seconds_bucket",
+             (("phase", "a"), ("le", "0.1")), "3"),
+            ("ict_phase_duration_seconds_bucket",
+             (("phase", "a"), ("le", "weird")), "3"),
+            ("ict_phase_duration_seconds_bucket",
+             (("phase", "b"), ("le", "0.1")), "9"),
+            ("ict_phase_duration_seconds_sum", (("phase", "a"),), "1.5"),
+        ]
+        cum = obs_metrics.bucket_cum(
+            [fam], "ict_phase_duration_seconds", {"phase": "a"})
+        assert cum == {0.1: 3.0}
+        # no filter: last writer wins per bound (both phases fold)
+        assert obs_metrics.bucket_cum(
+            [fam], "ict_phase_duration_seconds") == {0.1: 9.0}
+        # phase_hist_cum delegates here (behavior pinned unchanged)
+        assert fleet_obs.phase_hist_cum([fam], "a") == {0.1: 3.0}
+
+
+# --- MetricsHistory: bounded ring, series, lossless ticks ---
+
+
+def _fams(text):
+    return obs_metrics.parse_exposition(text)
+
+
+class TestMetricsHistory:
+    def test_ring_is_bounded_and_sequenced(self):
+        h = MetricsHistory(keep=3)
+        for i in range(5):
+            h.append(_fams(f"ict_x {i}\n"))
+        assert h.size() == 3
+        recs = h.window()
+        assert [r["tick"] for r in recs] == [2, 3, 4]
+        assert h.last_tick() == 4
+        assert [r["tick"] for r in h.window(2)] == [3, 4]
+        assert h.window(0) == []
+        # a negative clip is empty, never 'serve everything' (the
+        # recs[-0:] slice-degeneration regression)
+        assert h.window(-1) == []
+
+    def test_series_extraction_with_label_subset(self):
+        h = MetricsHistory(keep=8)
+        for v1, v2 in ((1, 10), (2, 20)):
+            h.append(_fams(
+                "# TYPE ict_g gauge\n"
+                f'ict_g{{replica="a",zone="z1"}} {v1}\n'
+                f'ict_g{{replica="b",zone="z1"}} {v2}\n'))
+        series = h.series("ict_g", (("replica", "a"),))
+        assert len(series) == 1
+        (key, pts), = series.items()
+        assert dict(key) == {"replica": "a", "zone": "z1"}
+        assert [(t, v) for t, _m, v in pts] == [(0, 1.0), (1, 2.0)]
+        # unfiltered: both series
+        assert len(h.series("ict_g")) == 2
+        # window clips to the newest ticks
+        assert all(len(pts) == 1
+                   for pts in h.series("ict_g", window=1).values())
+
+    def test_cum_series_groups_by_non_le_labels(self):
+        h = MetricsHistory(keep=4)
+        h.append(_fams(
+            "# TYPE ict_h histogram\n"
+            'ict_h_bucket{phase="p",le="0.1"} 1\n'
+            'ict_h_bucket{phase="p",le="+Inf"} 2\n'))
+        h.append(_fams(
+            "# TYPE ict_h histogram\n"
+            'ict_h_bucket{phase="p",le="0.1"} 4\n'
+            'ict_h_bucket{phase="p",le="+Inf"} 8\n'))
+        out = h.cum_series("ict_h")
+        (key, seq), = out.items()
+        assert dict(key) == {"phase": "p"}
+        assert seq[0][2] == {0.1: 1.0, float("inf"): 2.0}
+        assert seq[1][2] == {0.1: 4.0, float("inf"): 8.0}
+
+
+def test_history_ticks_rerender_byte_exact_including_specials():
+    """The satellite contract: parse → store in MetricsHistory →
+    re-render must be byte-exact per tick — +Inf/NaN gauge spellings,
+    escaped label values, HELP/TYPE lines, sample order, everything."""
+    texts = [
+        ("# HELP ict_eta backlog drain eta\n"
+         "# TYPE ict_eta gauge\n"
+         "ict_eta +Inf\n"
+         "ict_nan_gauge NaN\n"
+         "ict_neg -Inf\n"
+         '# TYPE ict_lbl counter\n'
+         'ict_lbl{tenant="we\\\\ird\\nten ant"} 3\n'
+         'ict_lbl{tenant="quo\\"ted"} 1.5\n'),
+        ("# TYPE ict_h histogram\n"
+         'ict_h_bucket{le="0.001"} 0\n'
+         'ict_h_bucket{le="+Inf"} 7\n'
+         "ict_h_sum 0.25\n"
+         "ict_h_count 7\n"),
+    ]
+    h = MetricsHistory(keep=8)
+    for text in texts:
+        h.append(obs_metrics.parse_exposition(text))
+    for rec, text in zip(h.window(), texts):
+        assert obs_metrics.render_exposition(rec["families"]) == text
+        # ...and through the strict-JSON shape the endpoint serves
+        json_fams = [fleet_history.family_to_json(f)
+                     for f in rec["families"]]
+        round_tripped = [fleet_history.family_from_json(o)
+                         for o in json.loads(json.dumps(json_fams))]
+        assert obs_metrics.render_exposition(round_tripped) == text
+
+
+# --- the rule grammar ---
+
+
+class TestParseRule:
+    def test_valid_rule_normalizes(self):
+        r = parse_rule({"name": "r1", "severity": "critical",
+                        "family": "ict_x",
+                        "labels": {"replica": "a"},
+                        "predicate": {"op": "gt", "value": "3"},
+                        "for_ticks": "2"})
+        assert r.for_ticks == 2
+        assert r.predicate == {"op": "gt", "value": 3.0}
+        assert r.labels == (("replica", "a"),)
+
+    @pytest.mark.parametrize("bad", [
+        "not a dict",
+        {"name": "", "family": "ict_x", "predicate": {"op": "gt",
+                                                      "value": 1}},
+        {"name": "r", "severity": "fatal", "family": "ict_x",
+         "predicate": {"op": "gt", "value": 1}},
+        {"name": "r", "family": "1bad name",
+         "predicate": {"op": "gt", "value": 1}},
+        {"name": "r", "family": "ict_x", "predicate": {"op": "nope",
+                                                       "value": 1}},
+        {"name": "r", "family": "ict_x", "predicate": {"op": "gt"}},
+        {"name": "r", "family": "ict_x",
+         "predicate": {"op": "delta_gt", "value": 1, "window": 0}},
+        {"name": "r", "family": "ict_x",
+         "predicate": {"op": "quantile_gt", "value": 1, "q": 1.5,
+                       "window": 2}},
+        {"name": "r", "family": "ict_x",
+         "predicate": {"op": "gt", "value": 1}, "for_ticks": 0},
+        {"name": "r", "family": "ict_x", "labels": "oops",
+         "predicate": {"op": "gt", "value": 1}},
+    ])
+    def test_bad_rules_raise(self, bad):
+        with pytest.raises(ValueError):
+            parse_rule(bad)
+
+    def test_duplicate_rule_names_rejected_by_engine(self):
+        r = parse_rule({"name": "dup", "family": "ict_x",
+                        "predicate": {"op": "gt", "value": 1}})
+        with pytest.raises(ValueError):
+            AlertEngine([r, r])
+
+    def test_window_beyond_history_ring_fails_fast(self):
+        """A rule whose window can never be satisfied by the ring must be
+        a construction error, not a silently-never-firing monitor."""
+        r = parse_rule({"name": "wide", "family": "ict_x",
+                        "predicate": {"op": "rate_gt", "value": 1,
+                                      "window": 32}})
+        with pytest.raises(ValueError, match="history ticks"):
+            AlertEngine([r], history_ticks=16)
+        AlertEngine([r], history_ticks=33)          # exactly enough
+        a = parse_rule({"name": "gone", "family": "ict_x",
+                        "predicate": {"op": "absent", "window": 16}})
+        AlertEngine([a], history_ticks=16)          # absent needs window
+        with pytest.raises(ValueError, match="history ticks"):
+            AlertEngine([a], history_ticks=15)
+        # the router wires its own --history_ticks through
+        from iterative_cleaner_tpu.fleet.router import (
+            FleetConfig,
+            FleetRouter,
+        )
+        with pytest.raises(ValueError, match="history ticks"):
+            FleetRouter(FleetConfig(
+                replicas=("http://127.0.0.1:9",), history_ticks=4))
+
+
+# --- the state machine: hysteresis, dedup, freeze, every op ---
+
+
+def _gauge_tick(h, value, extra=""):
+    h.append(_fams(f"# TYPE ict_g gauge\nict_g {value}\n{extra}"))
+
+
+class TestAlertEngine:
+    def test_for_ticks_hysteresis_and_one_tick_resolve(self):
+        rule = parse_rule({"name": "hot", "severity": "warning",
+                           "family": "ict_g",
+                           "predicate": {"op": "gt", "value": 5},
+                           "for_ticks": 3})
+        eng = AlertEngine([rule])
+        h = MetricsHistory(keep=8)
+        for i in range(2):
+            _gauge_tick(h, 9)
+            v = eng.evaluate(h)
+            assert v["fired"] == [] and v["firing"] == []
+        _gauge_tick(h, 9)
+        v = eng.evaluate(h)           # third consecutive breach fires
+        assert [a["rule"] for a in v["fired"]] == ["hot"]
+        assert v["fired"][0]["value"] == 9.0
+        assert v["fired"][0]["severity"] == "warning"
+        # dedup: staying hot does not re-fire
+        _gauge_tick(h, 11)
+        v = eng.evaluate(h)
+        assert v["fired"] == [] and len(v["firing"]) == 1
+        assert eng.firing_counts() == {"hot": 1}
+        # ONE in-bounds tick resolves
+        _gauge_tick(h, 1)
+        v = eng.evaluate(h)
+        assert [a["rule"] for a in v["resolved"]] == ["hot"]
+        assert v["resolved"][0]["state"] == "resolved"
+        assert eng.firing_counts() == {"hot": 0}
+        # the transitions landed in recent, firing then resolved
+        states = [t["state"] for t in eng.recent()]
+        assert states == ["firing", "resolved"]
+
+    def test_missing_series_freezes_instead_of_resolving(self):
+        rule = parse_rule({"name": "hot", "family": "ict_g",
+                           "predicate": {"op": "gt", "value": 5}})
+        eng = AlertEngine([rule])
+        h = MetricsHistory(keep=8)
+        _gauge_tick(h, 9)
+        assert [a["rule"] for a in eng.evaluate(h)["fired"]] == ["hot"]
+        # the series vanishes (failed scrape): no resolve, flag kept
+        h.append(_fams("# TYPE ict_other gauge\nict_other 1\n"))
+        v = eng.evaluate(h)
+        assert v["resolved"] == [] and len(v["firing"]) == 1
+
+    def test_per_series_firing_by_label(self):
+        rule = parse_rule({"name": "stale", "family": "ict_age",
+                           "predicate": {"op": "gt", "value": 3}})
+        eng = AlertEngine([rule])
+        h = MetricsHistory(keep=8)
+        h.append(_fams('# TYPE ict_age gauge\n'
+                       'ict_age{replica="a"} 10\n'
+                       'ict_age{replica="b"} 1\n'))
+        v = eng.evaluate(h)
+        assert [a["labels"] for a in v["fired"]] == [{"replica": "a"}]
+        h.append(_fams('# TYPE ict_age gauge\n'
+                       'ict_age{replica="a"} 10\n'
+                       'ict_age{replica="b"} 9\n'))
+        v = eng.evaluate(h)
+        assert [a["labels"] for a in v["fired"]] == [{"replica": "b"}]
+        assert eng.firing_counts() == {"stale": 2}
+
+    def test_delta_and_rate_predicates(self):
+        delta_rule = parse_rule({"name": "moved", "family": "ict_c",
+                                 "predicate": {"op": "delta_gt",
+                                               "value": 0, "window": 1}})
+        rate_rule = parse_rule({"name": "fast", "family": "ict_c",
+                                "predicate": {"op": "rate_gt",
+                                              "value": 5.0, "window": 2}})
+        eng = AlertEngine([delta_rule, rate_rule])
+        h = MetricsHistory(keep=8)
+        h.append(_fams("# TYPE ict_c counter\nict_c 10\n"))
+        v = eng.evaluate(h)
+        assert v["fired"] == []      # one tick: no window yet (frozen)
+        h.append(_fams("# TYPE ict_c counter\nict_c 14\n"))
+        v = eng.evaluate(h)
+        assert [a["rule"] for a in v["fired"]] == ["moved"]
+        h.append(_fams("# TYPE ict_c counter\nict_c 14\n"))
+        # pin the window's wall span to 1s: delta 4 over the 3-tick
+        # window -> 4/s < 5 -> rate rule stays quiet; then a burst
+        recs = h.window()
+        recs[0]["ts_mono"], recs[-1]["ts_mono"] = 0.0, 1.0
+        v = eng.evaluate(h)
+        assert all(a["rule"] != "fast" for a in v["fired"])
+        h.append(_fams("# TYPE ict_c counter\nict_c 30\n"))
+        recs = h.window()
+        recs[-3]["ts_mono"], recs[-1]["ts_mono"] = 0.0, 1.0
+        v = eng.evaluate(h)          # delta 16 over 1s > 5/s
+        assert "fast" in [a["rule"] for a in v["fired"]]
+        # counter reset: negative delta never fires
+        h.append(_fams("# TYPE ict_c counter\nict_c 0\n"))
+        v = eng.evaluate(h)
+        assert v["fired"] == []
+
+    def test_absent_predicate_needs_full_window_then_fires(self):
+        rule = parse_rule({"name": "gone", "family": "ict_present",
+                           "predicate": {"op": "absent", "window": 2}})
+        eng = AlertEngine([rule])
+        h = MetricsHistory(keep=8)
+        h.append(_fams("# TYPE ict_other gauge\nict_other 1\n"))
+        assert eng.evaluate(h)["fired"] == []   # short history: no verdict
+        h.append(_fams("# TYPE ict_other gauge\nict_other 1\n"))
+        v = eng.evaluate(h)
+        assert [a["rule"] for a in v["fired"]] == ["gone"]
+        # the series appearing resolves it
+        h.append(_fams("# TYPE ict_present gauge\nict_present 1\n"))
+        v = eng.evaluate(h)
+        assert [a["rule"] for a in v["resolved"]] == ["gone"]
+
+    def test_lazily_registered_counter_fires_on_first_appearance(self):
+        """The gt-0 shape the critical default rules rely on: a counter
+        that first APPEARS at value 1 (lazy registration — there is no
+        prior 0 sample) must fire a threshold rule on that very tick."""
+        rule = parse_rule({"name": "div", "severity": "critical",
+                           "family": "ict_audit_divergences",
+                           "predicate": {"op": "gt", "value": 0}})
+        eng = AlertEngine([rule])
+        h = MetricsHistory(keep=8)
+        h.append(_fams("# TYPE ict_other gauge\nict_other 1\n"))
+        assert eng.evaluate(h)["fired"] == []
+        h.append(_fams('# TYPE ict_audit_divergences counter\n'
+                       'ict_audit_divergences{replica="a"} 1\n'))
+        v = eng.evaluate(h)
+        assert [a["rule"] for a in v["fired"]] == ["div"]
+
+    def test_forget_drops_departed_replica_series(self):
+        """Scale-down parity with ScrapeCache/StragglerDetector.forget:
+        a departed replica's firing series must not pin the engine (and
+        the gauge) forever via the freeze-on-missing rule."""
+        rule = parse_rule({"name": "stale", "family": "ict_age",
+                           "predicate": {"op": "gt", "value": 3}})
+        eng = AlertEngine([rule])
+        h = MetricsHistory(keep=8)
+        h.append(_fams('# TYPE ict_age gauge\n'
+                       'ict_age{replica="gone"} 10\n'
+                       'ict_age{replica="stays"} 10\n'))
+        assert len(eng.evaluate(h)["fired"]) == 2
+        eng.forget("gone")
+        assert eng.firing_counts() == {"stale": 1}
+        assert [a["labels"] for a in eng.firing()] == [{"replica": "stays"}]
+        # the synthetic resolution is traceable in the recent ring
+        notes = [t for t in eng.recent() if t.get("note")]
+        assert notes and notes[0]["labels"] == {"replica": "gone"}
+        assert notes[0]["state"] == "resolved"
+
+    def test_quantile_predicate_uses_windowed_bucket_deltas(self):
+        rule = parse_rule({"name": "slow_p99", "family": "ict_h",
+                           "predicate": {"op": "quantile_gt", "q": 0.99,
+                                         "value": 0.5, "window": 1}})
+        eng = AlertEngine([rule])
+        h = MetricsHistory(keep=8)
+        h.append(_fams('# TYPE ict_h histogram\n'
+                       'ict_h_bucket{le="0.1"} 100\n'
+                       'ict_h_bucket{le="1.0"} 100\n'
+                       'ict_h_bucket{le="+Inf"} 100\n'))
+        assert eng.evaluate(h)["fired"] == []    # no delta yet
+        # 10 NEW observations, all in the (0.1, 1.0] bucket: windowed
+        # p99 = 1.0 > 0.5 even though the CUMULATIVE histogram is fast
+        h.append(_fams('# TYPE ict_h histogram\n'
+                       'ict_h_bucket{le="0.1"} 100\n'
+                       'ict_h_bucket{le="1.0"} 110\n'
+                       'ict_h_bucket{le="+Inf"} 110\n'))
+        v = eng.evaluate(h)
+        assert [a["rule"] for a in v["fired"]] == ["slow_p99"]
+        assert v["fired"][0]["value"] == 1.0
+
+
+# --- the default pack ---
+
+
+def test_default_rule_pack_encodes_documented_invariants():
+    rules = {r.name: r for r in default_rule_pack(
+        poll_interval_s=1.0, scale_up_eta_s=10.0, autoscale="off")}
+    assert set(rules) == {
+        "audit_divergence", "backend_demoted", "scrape_stale",
+        "spool_disk_low", "compile_cache_thrash",
+        "backlog_behind_unscaled"}
+    assert rules["audit_divergence"].severity == "critical"
+    assert rules["audit_divergence"].family == "ict_audit_divergences"
+    # gt-0 thresholds, NOT delta predicates: these counters are lazily
+    # registered (first appear at value 1), so a delta rule would never
+    # see the 0 -> 1 edge and the critical alerts could never fire
+    assert rules["audit_divergence"].predicate == {"op": "gt", "value": 0.0}
+    assert rules["backend_demoted"].predicate == {"op": "gt", "value": 0.0}
+    assert rules["scrape_stale"].predicate["value"] == pytest.approx(3.0)
+    assert (rules["backlog_behind_unscaled"].predicate["value"]
+            == pytest.approx(10.0))
+    # with the autoscaler on, the scaler owns the backlog signal
+    on = {r.name for r in default_rule_pack(autoscale="act")}
+    assert "backlog_behind_unscaled" not in on
+
+
+# --- bundles: atomic write, retention, inventory ---
+
+
+def test_alert_bundles_atomic_and_retained(tmp_path):
+    d = str(tmp_path / "alerts")
+    paths = []
+    for i in range(MAX_ALERT_BUNDLES_KEPT + 2):
+        p = fleet_alerts.write_alert_bundle(
+            d, alert={"rule": f"r{i}", "severity": "info",
+                      "labels": {}, "samples": [{"tick": i}]},
+            rule={"name": f"r{i}"},
+            window=[{"tick": i, "families": []}])
+        assert p is not None
+        paths.append(p)
+        time.sleep(0.002)
+    names = sorted(os.listdir(d))
+    assert len(names) == MAX_ALERT_BUNDLES_KEPT
+    assert not any(n.endswith(".part") for n in names)
+    assert os.path.basename(paths[-1]) in names
+    assert os.path.basename(paths[0]) not in names
+    listed = fleet_alerts.list_alert_bundles(d)
+    assert len(listed) == MAX_ALERT_BUNDLES_KEPT
+    assert listed[-1]["rule"] == f"r{MAX_ALERT_BUNDLES_KEPT + 1}"
+    assert sorted(os.listdir(paths[-1])) == ["history.json",
+                                             "manifest.json"]
+    with open(os.path.join(paths[-1], "history.json")) as fh:
+        assert json.load(fh)["ticks"][0]["tick"] == (
+            MAX_ALERT_BUNDLES_KEPT + 1)
+
+
+# --- sinks: webhook + command, full-jitter retry ---
+
+
+class _Hook(http.server.BaseHTTPRequestHandler):
+    bodies: list = []
+    fail_first = 0
+
+    def do_POST(self):  # noqa: N802 — stdlib signature
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        cls = type(self)
+        if cls.fail_first > 0:
+            cls.fail_first -= 1
+            self.send_response(500)
+            self.end_headers()
+            return
+        cls.bodies.append(json.loads(body))
+        self.send_response(200)
+        self.end_headers()
+
+    def log_message(self, fmt, *args):  # noqa: A003 — stdlib signature
+        pass
+
+
+@pytest.fixture
+def hook_server():
+    _Hook.bodies = []
+    _Hook.fail_first = 0
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _Hook)
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}/hook"
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_webhook_sink_delivers_and_retries(hook_server):
+    outcomes = []
+    sinks = AlertSinks(webhook=hook_server, retries=3,
+                       retry_backoff_s=0.01,
+                       note=lambda s, st: outcomes.append((s, st)))
+    assert sinks.active()
+    _Hook.fail_first = 2       # first two attempts 500 -> jittered retry
+    sinks.notify({"rule": "r1", "state": "firing"})
+    deadline = time.time() + 30
+    while not _Hook.bodies and time.time() < deadline:
+        time.sleep(0.01)
+    sinks.stop()
+    assert [b["rule"] for b in _Hook.bodies] == ["r1"]
+    assert ("webhook", "ok") in outcomes
+
+    # exhausted retries count an error, not an exception
+    outcomes2 = []
+    sinks2 = AlertSinks(webhook="http://127.0.0.1:1/nope", retries=1,
+                        retry_backoff_s=0.01,
+                        note=lambda s, st: outcomes2.append((s, st)))
+    sinks2.notify({"rule": "r2", "state": "firing"})
+    deadline = time.time() + 30
+    while ("webhook", "error") not in outcomes2 and time.time() < deadline:
+        time.sleep(0.01)
+    sinks2.stop()
+    assert ("webhook", "error") in outcomes2
+
+
+def test_command_sink_gets_json_on_stdin(tmp_path):
+    out = tmp_path / "alert.json"
+    outcomes = []
+    sinks = AlertSinks(command=f"cat > {out}", retries=0,
+                       note=lambda s, st: outcomes.append((s, st)))
+    sinks.notify({"rule": "cmd_rule", "state": "firing"})
+    deadline = time.time() + 30
+    while not outcomes and time.time() < deadline:
+        time.sleep(0.01)
+    sinks.stop()
+    assert outcomes == [("cmd", "ok")]
+    assert json.loads(out.read_text())["rule"] == "cmd_rule"
+
+
+def test_disabled_sinks_are_inert():
+    sinks = AlertSinks()
+    assert not sinks.active()
+    sinks.notify({"rule": "x"})   # no thread, no queue growth, no error
+    sinks.stop()
+
+
+def test_sinks_stop_returns_promptly_with_full_queue():
+    """Router shutdown must not drain a wedged sink's retry ladder: a
+    FULL queue behind an unreachable webhook used to block stop() on a
+    plain put() for up to the whole backlog's retry time."""
+    sinks = AlertSinks(webhook="http://127.0.0.1:1/nope", retries=50,
+                       retry_backoff_s=5.0)
+    for i in range(AlertSinks.QUEUE_MAX + 10):   # overfill: some dropped
+        sinks.notify({"rule": f"r{i}", "state": "firing"})
+    t0 = time.monotonic()
+    sinks.stop(timeout_s=8.0)
+    # bounded by one in-flight connection attempt + the join timeout —
+    # nowhere near the ~minutes a retries=50 ladder per item would take
+    assert time.monotonic() - t0 < 15.0
+
+
+# --- end to end: router wiring, endpoints, lifecycle ---
+
+
+def test_router_alert_lifecycle_e2e(tmp_path):
+    """An injected tiny-threshold rule over the fleet's own gauges:
+    fires on a poll tick (counter + firing gauge + bundle + /healthz
+    summary + /fleet/alerts), resolves when the replica set changes
+    underneath it, and the history endpoint serves lossless ticks —
+    all evaluation off the poll-tick snapshot, zero extra scrapes."""
+    svc = _start_replica(tmp_path, "al-a")
+    router = _start_router(
+        svc, default_alerts=False,
+        alert_rules=({
+            "name": "alive_watch", "severity": "info",
+            "family": "ict_fleet_replicas",
+            "labels": {"state": "alive"},
+            "predicate": {"op": "gt", "value": 0}, "for_ticks": 2,
+            "description": "test rule"},))
+    try:
+        router.poll_tick()
+        assert router.alerts.firing() == []      # for_ticks hysteresis
+        router.poll_tick()
+        firing = router.alerts.firing()
+        assert [a["rule"] for a in firing] == ["alive_watch"]
+        # counter + gauge on the router exposition, strict grammar
+        assert router.metrics.counter_value(
+            "fleet_alerts_total",
+            {"rule": "alive_watch", "severity": "info"}) == 1
+        text = router.metrics.render()
+        _parse_prometheus(text)
+        assert 'ict_fleet_alerts_firing{rule="alive_watch"} 1' in text
+        # /fleet/alerts: firing + rules table + bundle inventory
+        view = _get(router, "/fleet/alerts")
+        assert [a["rule"] for a in view["firing"]] == ["alive_watch"]
+        assert view["rules"][0]["firing_series"] == 1
+        assert view["bundles"] and view["bundles"][0]["rule"] == \
+            "alive_watch"
+        assert view["sinks"] == {"webhook": False, "cmd": False}
+        # the on-disk bundle carries rule + samples + history window
+        bundle = view["bundles"][0]["path"]
+        with open(os.path.join(bundle, "manifest.json")) as fh:
+            manifest = json.load(fh)
+        assert manifest["alert"]["rule"] == "alive_watch"
+        assert manifest["rule"]["name"] == "alive_watch"
+        assert manifest["alert"]["samples"]
+        with open(os.path.join(bundle, "history.json")) as fh:
+            ticks = json.load(fh)["ticks"]
+        assert ticks and all("families" in t for t in ticks)
+        # /healthz firing summary
+        health = _get(router, "/healthz")
+        assert health["alerts"]["firing"] == 1
+        assert health["alerts"]["rules"] == ["alive_watch"]
+        assert health["alerts"]["critical"] == 0
+        # dedup: more ticks, no second firing
+        router.poll_tick()
+        assert router.metrics.counter_value(
+            "fleet_alerts_total",
+            {"rule": "alive_watch", "severity": "info"}) == 1
+        # history endpoint: lossless ticks, ?ticks clipping, strict JSON
+        hist = _get(router, "/fleet/metrics/history?ticks=2")
+        assert len(hist["ticks"]) == 2
+        fams = [fleet_history.family_from_json(o)
+                for o in hist["ticks"][-1]["families"]]
+        _parse_prometheus(obs_metrics.render_exposition(fams))
+        assert _get(router, "/fleet/metrics/history?ticks=oops",
+                    expect_error=True) == 400
+        assert _get(router, "/fleet/metrics/history?ticks=-1",
+                    expect_error=True) == 400
+        # kill the replica: alive drops to 0 -> ONE in-bounds tick
+        # resolves (dead_after=2 in the harness)
+        svc.stop()
+        deadline = time.time() + 60
+        while router.alerts.firing() and time.time() < deadline:
+            router.poll_tick()
+            time.sleep(0.02)
+        assert router.alerts.firing() == []
+        recent = [t["state"] for t in router.alerts.recent()]
+        assert recent == ["firing", "resolved"]
+        assert 'ict_fleet_alerts_firing{rule="alive_watch"} 0' in \
+            router.metrics.render()
+    finally:
+        router.stop()
+
+
+def test_daemon_preregisters_correctness_counters(tmp_path):
+    """The restart-resolution contract behind the gt-0 critical rules: a
+    freshly started replica must EXPORT ict_audit_divergences and
+    ict_service_backend_demotions (pre-registered at 0) — a missing
+    series would let freeze-on-missing pin a previously-fired critical
+    alert across a clean restart forever."""
+    import urllib.request
+
+    svc = _start_replica(tmp_path, "prereg")
+    try:
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}/metrics", timeout=30).read(
+        ).decode()
+        names = {n for fam in obs_metrics.parse_exposition(text)
+                 for n, _l, _v in fam.samples}
+        assert "ict_audit_divergences" in names
+        assert "ict_service_backend_demotions" in names
+    finally:
+        svc.stop()
+
+
+def test_router_default_pack_and_rule_override(tmp_path):
+    """The default pack installs against a real router; an operator rule
+    re-using a default name replaces it (threshold tuning without
+    --no_default_alerts)."""
+    svc = _start_replica(tmp_path, "dp-a")
+    router = _start_router(
+        svc,
+        alert_rules=({
+            "name": "scrape_stale", "severity": "critical",
+            "family": "ict_fleet_scrape_age_seconds",
+            "predicate": {"op": "gt", "value": 99.0}, "for_ticks": 1},))
+    try:
+        names = [r.name for r in router.alerts.rules]
+        assert names.count("scrape_stale") == 1
+        rule = next(r for r in router.alerts.rules
+                    if r.name == "scrape_stale")
+        assert rule.severity == "critical"
+        assert rule.predicate["value"] == 99.0
+        assert "audit_divergence" in names
+        assert "backlog_behind_unscaled" in names   # autoscale off
+        # a healthy fleet fires none of the router-signal rules.  The
+        # counter-watching rules (audit_divergence, backend_demoted) are
+        # NOT asserted quiet here: the in-process replica shares the
+        # process-global tracing registry, so a full-suite run's earlier
+        # audit/demotion tests legitimately leave those counters nonzero
+        # (each real replica is its own process); spool_disk_low is
+        # runner-disk-dependent.
+        for _ in range(3):
+            router.poll_tick()
+        firing = {a["rule"] for a in router.alerts.firing()}
+        assert not ({"scrape_stale", "backlog_behind_unscaled",
+                     "compile_cache_thrash"} & firing)
+    finally:
+        router.stop()
+        svc.stop()
+
+
+def test_fleet_cli_alert_flags(tmp_path):
+    """The CLI surface: --alert_rule JSON validates at parse time,
+    --alert_rules reads a file, bad grammar is an actionable error."""
+    from iterative_cleaner_tpu.fleet.router import (
+        build_fleet_parser,
+        fleet_config_from_args,
+    )
+
+    rules_file = tmp_path / "rules.json"
+    rules_file.write_text(json.dumps([
+        {"name": "from_file", "family": "ict_x",
+         "predicate": {"op": "lt", "value": 2}}]))
+    args = build_fleet_parser().parse_args([
+        "--replica", "http://127.0.0.1:9",
+        "--alert_rule", json.dumps({
+            "name": "inline", "family": "ict_y",
+            "predicate": {"op": "gt", "value": 1}}),
+        "--alert_rules", str(rules_file),
+        "--history_ticks", "16",
+        "--alert_webhook", "http://127.0.0.1:9/hook",
+        "--no_default_alerts"])
+    cfg = fleet_config_from_args(args)
+    assert cfg.history_ticks == 16
+    assert not cfg.default_alerts
+    assert [r["name"] for r in cfg.alert_rules] == ["inline", "from_file"]
+    assert cfg.alert_webhook.endswith("/hook")
+    for bad in (["--alert_rule", "not json"],
+                ["--alert_rule", '{"name": "x"}'],
+                ["--history_ticks", "0"],
+                ["--alert_retries", "-1"],
+                ["--alert_rules", str(tmp_path / "missing.json")]):
+        args = build_fleet_parser().parse_args(
+            ["--replica", "http://127.0.0.1:9", *bad])
+        with pytest.raises(ValueError):
+            fleet_config_from_args(args)
+
+
+def test_fleet_top_shows_firing_alerts(tmp_path, capsys):
+    """tools/fleet_top.py: the FIRING ALERTS section in table mode, the
+    alerts block on the --json line, and --watch N refreshing."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "fleet_top", os.path.join(repo, "tools", "fleet_top.py"))
+    fleet_top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fleet_top)
+
+    svc = _start_replica(tmp_path, "ft-a")
+    router = _start_router(
+        svc, default_alerts=False,
+        alert_rules=({
+            "name": "always_on", "severity": "critical",
+            "family": "ict_fleet_replicas",
+            "labels": {"state": "alive"},
+            "predicate": {"op": "gt", "value": 0}, "for_ticks": 1},))
+    try:
+        router.poll_tick()
+        base = f"http://127.0.0.1:{router.port}"
+        assert fleet_top.main(["--router", base, "--json"]) == 0
+        snap = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert [a["rule"] for a in snap["alerts"]["firing"]] == [
+            "always_on"]
+        assert fleet_top.main(["--router", base]) == 0
+        out = capsys.readouterr().out
+        assert "FIRING ALERTS" in out
+        assert "always_on" in out and "critical" in out
+        # --watch N with the --iterations test hook: two refreshes
+        assert fleet_top.main(["--router", base, "--watch", "0.01",
+                               "--iterations", "2", "--json"]) == 0
+        lines = [ln for ln in capsys.readouterr().out.splitlines() if ln]
+        assert len(lines) == 2
+        for ln in lines:
+            assert json.loads(ln)["router_id"] == router.router_id
+    finally:
+        router.stop()
+        svc.stop()

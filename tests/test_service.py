@@ -535,3 +535,76 @@ def test_root_resolves_symlinks_and_revalidates_on_replay(tmp_path):
         assert replayed.state == "error" and "outside --root" in replayed.error
     finally:
         svc2.stop()
+
+
+def test_subprocess_daemon_first_job_survives_import_race(tmp_path):
+    """Regression: a REAL `ict-serve` subprocess (jax never imported when
+    the first job arrives) used to wedge forever — the loader pool's
+    threads raced the first `import jax` chain against the tick loop's
+    liveness check (`from jax._src import xla_bridge`), CPython's
+    circular-import deadlock avoidance handed someone a
+    partially-initialized module, and every loader thread died with the
+    job stuck in the load queue.  Now: the liveness check reads
+    sys.modules instead of importing, the loader import is serialized,
+    and the first job must complete with the oracle's mask."""
+    import os
+    import subprocess
+    import sys
+
+    p = _write(tmp_path, "sub.npz", seed=77)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo_root + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "iterative_cleaner_tpu", "serve",
+         "--port", "0", "--spool", str(tmp_path / "sub_spool"),
+         "--replica_id", "sub", "--backend", "numpy",
+         "--deadline_s", "0.2"],
+        stderr=subprocess.PIPE, text=True, env=env, cwd=str(tmp_path))
+    stderr_lines = []
+    try:
+        deadline = time.time() + 120
+        line = ""
+        while time.time() < deadline:
+            line = proc.stderr.readline()
+            stderr_lines.append(line)
+            if not line or "listening" in line:
+                break
+        assert "listening" in line, f"unexpected startup: {line!r}"
+        port = int(line.rsplit(":", 1)[1].split()[0].split("(")[0])
+        # drain stderr from here so request logging can't fill the pipe
+        import threading
+        threading.Thread(target=lambda: stderr_lines.extend(proc.stderr),
+                         daemon=True).start()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/jobs",
+            data=json.dumps({"path": p}).encode(),
+            headers={"Content-Type": "application/json"})
+        job = json.load(urllib.request.urlopen(req, timeout=30))
+        state = {}
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            state = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/jobs/{job['id']}", timeout=10))
+            if state.get("state") in ("done", "error"):
+                break
+            time.sleep(0.25)
+        assert state.get("state") == "done", (
+            f"job never completed: {state.get('state')!r} "
+            f"(stderr: {''.join(stderr_lines)[-2000:]!r})")
+        got = NpzIO().load(state["out_path"])
+        cfg = CleanConfig(backend="numpy")
+        from iterative_cleaner_tpu.parallel.batch import finalize_weights
+        want, _rfi = finalize_weights(
+            clean_cube(*preprocess(NpzIO().load(p)), cfg).weights, cfg)
+        np.testing.assert_array_equal(got.weights, want)
+        assert not any("partially initialized" in ln
+                       for ln in stderr_lines)
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)

@@ -1,18 +1,22 @@
 #!/usr/bin/env python
-"""fleet_top: one-shot terminal snapshot of a fleet router.
+"""fleet_top: terminal snapshot (or live watch) of a fleet router.
 
 ``python tools/fleet_top.py --router http://host:8790`` fetches the
-router's ``/healthz``, ``GET /fleet/capacity``, and ``GET
-/fleet/metrics`` and prints one human-readable snapshot: per-replica
-state (alive/draining/dead, straggler and autoscale-managed flags,
-queue depths, utilization, service rate, dispatch p50), per-bucket
-backlog/demand/drain-ETA rows, the fleet totals, and the autoscaler
-state.  ``--json`` prints the same snapshot as ONE JSON line for
-scripting (the bench.py one-line contract).  Read-only: three GETs, no
-mutation, safe against a production router.
+router's ``/healthz``, ``GET /fleet/capacity``, ``GET /fleet/alerts``,
+and ``GET /fleet/metrics`` and prints one human-readable snapshot:
+per-replica state (alive/draining/dead, straggler and
+autoscale-managed flags, queue depths, utilization, service rate,
+dispatch p50), per-bucket backlog/demand/drain-ETA rows, the fleet
+totals, the autoscaler state, and a FIRING ALERTS section off the
+alerting plane.  ``--json`` prints the same snapshot as ONE JSON line
+for scripting (the bench.py one-line contract); ``--watch N``
+re-renders every N seconds until interrupted (one JSON line per
+refresh in ``--json`` mode).  Read-only: four GETs, no mutation, safe
+against a production router.
 
 Offline-smoke-testable: tests stand up an in-process fleet and point
-``main(["--router", url])`` at it (tests/test_autoscale.py).
+``main(["--router", url])`` at it (tests/test_autoscale.py,
+tests/test_fleet_alerts.py).
 """
 
 from __future__ import annotations
@@ -20,6 +24,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 import urllib.error
 import urllib.request
 
@@ -35,14 +40,18 @@ def _get_text(base: str, route: str, timeout_s: float) -> str:
 
 
 def collect(base: str, timeout_s: float = 10.0) -> dict:
-    """The snapshot dict both output modes render: healthz + capacity,
-    with the straggler/p50 gauges read off the router's own exposition
-    (everything fleet_top shows is an exported figure — the
+    """The snapshot dict both output modes render: healthz + capacity +
+    alerts, with the straggler/p50 gauges read off the router's own
+    exposition (everything fleet_top shows is an exported figure — the
     explainability contract, docs/OBSERVABILITY.md)."""
     from iterative_cleaner_tpu.obs import metrics as obs_metrics
 
     health = _get_json(base, "/healthz", timeout_s)
     capacity = _get_json(base, "/fleet/capacity", timeout_s)
+    try:
+        alerts = _get_json(base, "/fleet/alerts", timeout_s)
+    except (urllib.error.URLError, OSError, ValueError):
+        alerts = {}   # pre-alerting routers still render everything else
     p50s: dict[str, float] = {}
     scale_events = 0.0
     try:
@@ -62,6 +71,7 @@ def collect(base: str, timeout_s: float = 10.0) -> dict:
         "router_id": health.get("router_id"),
         "health": health,
         "capacity": capacity,
+        "alerts": alerts,
         "p50s": p50s,
         "scale_events_total": scale_events,
     }
@@ -79,8 +89,8 @@ def _fmt_num(value) -> str:
 
 
 def render(snap: dict) -> str:
-    """The human view: replicas, buckets, fleet, autoscale — aligned
-    columns, one screen."""
+    """The human view: replicas, buckets, fleet, autoscale, firing
+    alerts — aligned columns, one screen."""
     health = snap["health"]
     capacity = snap["capacity"]
     caps = capacity.get("replicas", {})
@@ -148,38 +158,91 @@ def render(snap: dict) -> str:
                if last else "")]
     else:
         lines += ["autoscale off"]
+    lines += render_alerts(snap.get("alerts") or {})
     return "\n".join(lines)
+
+
+def render_alerts(alerts: dict) -> list[str]:
+    """The FIRING ALERTS section (from ``GET /fleet/alerts``): one row
+    per firing (rule, series) — severity, rule, series labels, the
+    evaluated value, and how long it has been firing."""
+    firing = alerts.get("firing") or []
+    if not firing:
+        return ["", "alerts: none firing"
+                + (f"  ({len(alerts.get('rules', []))} rules loaded)"
+                   if alerts.get("rules") else "")]
+    lines = ["", "FIRING ALERTS",
+             f"{'SEVERITY':<9} {'RULE':<28} {'SERIES':<24} {'VALUE':>10} "
+             f"{'FOR_S':>7}"]
+    now = time.time()
+    for a in firing:
+        labels = ",".join(f"{k}={v}"
+                          for k, v in sorted((a.get("labels") or {}).items()))
+        since = a.get("since_ts") or 0
+        lines.append(
+            f"{a.get('severity', '?'):<9} {a.get('rule', '?'):<28} "
+            f"{labels or 'fleet':<24} {_fmt_num(a.get('value')):>10} "
+            f"{_fmt_num(max(now - since, 0.0) if since else None):>7}")
+    return lines
 
 
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="fleet_top",
-        description="One-shot snapshot of a fleet router's capacity view "
-                    "(/healthz + /fleet/capacity + /metrics; read-only)")
+        description="Snapshot (or --watch) of a fleet router's capacity "
+                    "and alerting view (/healthz + /fleet/capacity + "
+                    "/fleet/alerts + /metrics; read-only)")
     p.add_argument("--router", default="http://127.0.0.1:8790",
                    metavar="URL", help="router base URL "
                    "(default http://127.0.0.1:8790)")
     p.add_argument("--json", action="store_true",
-                   help="one machine-readable JSON line instead of the "
-                        "terminal table")
+                   help="one machine-readable JSON line (per refresh in "
+                        "--watch mode) instead of the terminal table")
+    p.add_argument("--watch", type=float, default=0.0, metavar="N",
+                   help="continuous-refresh mode: re-render every N "
+                        "seconds until interrupted (0 = one shot, the "
+                        "default)")
+    p.add_argument("--iterations", type=int, default=0, metavar="K",
+                   help="with --watch: stop after K refreshes "
+                        "(0 = until interrupted; the offline-test hook)")
     p.add_argument("--timeout_s", type=float, default=10.0, metavar="S")
     args = p.parse_args(argv)
     base = args.router.rstrip("/")
-    try:
-        snap = collect(base, timeout_s=args.timeout_s)
-    except (urllib.error.URLError, OSError, ValueError) as exc:
+
+    def one_shot() -> int:
+        try:
+            snap = collect(base, timeout_s=args.timeout_s)
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            if args.json:
+                print(json.dumps({"error": f"router unreachable: {exc}",
+                                  "router": base}))
+            else:
+                print(f"error: router unreachable at {base}: {exc}",
+                      file=sys.stderr)
+            return 1
         if args.json:
-            print(json.dumps({"error": f"router unreachable: {exc}",
-                              "router": base}))
+            print(json.dumps(snap, default=str))
         else:
-            print(f"error: router unreachable at {base}: {exc}",
-                  file=sys.stderr)
-        return 1
-    if args.json:
-        print(json.dumps(snap, default=str))
-    else:
-        print(render(snap))
-    return 0
+            if args.watch > 0 and sys.stdout.isatty():
+                # Clear + home between refreshes on a real terminal;
+                # piped output gets plain successive snapshots.
+                print("\x1b[2J\x1b[H", end="")
+            print(render(snap))
+        return 0
+
+    if args.watch <= 0:
+        return one_shot()
+    n = 0
+    rc = 0
+    try:
+        while True:
+            rc = one_shot()
+            n += 1
+            if args.iterations and n >= args.iterations:
+                return rc
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return rc
 
 
 if __name__ == "__main__":
