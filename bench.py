@@ -184,6 +184,18 @@ def _headline(payload: dict) -> dict:
     except Exception:  # noqa: BLE001 — the JSON line is the contract
         pass
     try:
+        from iterative_cleaner_tpu.ingest import cas as _cas
+
+        # Coalesce/content-cache accounting for exit paths where the
+        # dedicated section never RAN (watchdog / early exception): the
+        # cumulative cache counters (pure counter reads — cannot hang).
+        # A section that ran keeps its own block — measured figures on
+        # success, the error + counters shape on a section failure.
+        payload.setdefault("coalesce", {"cache": {
+            "counters": _cas.cache_report()}})
+    except Exception:  # noqa: BLE001 — the JSON line is the contract
+        pass
+    try:
         from iterative_cleaner_tpu.analysis.contracts import ROUTE_DONATIONS
 
         # The donation ledger travels in the payload so the perf gate can
@@ -710,6 +722,115 @@ def _bench_ingest(state) -> dict:
     return res
 
 
+def _bench_coalesce() -> dict:
+    """Request-coalescing + content-cache arm (ROADMAP item 2's
+    throughput tier): K same-shape small cubes cleaned as ONE vmapped
+    batched dispatch vs K solo dispatches — the serving scheduler's
+    coalescing rung measured at the parallel layer, warm on both sides —
+    plus the content-addressed result cache's hit round-trip and
+    byte-identity.  Small cubes by design: launch amortization is the
+    campaign-of-small-jobs win (one executable launch per K cubes), and
+    the masks must be bit-identical batch-vs-solo AND vs the numpy
+    oracle per cube.  Cheap at every config (the gate requires this
+    block); BENCH_COALESCE_K overrides K (default 8)."""
+    import tempfile
+
+    from iterative_cleaner_tpu.config import CleanConfig
+    from iterative_cleaner_tpu.core.cleaner import clean_cube
+    from iterative_cleaner_tpu.ingest import cas
+    from iterative_cleaner_tpu.io.synthetic import make_archive
+    from iterative_cleaner_tpu.ops.preprocess import preprocess
+    from iterative_cleaner_tpu.parallel.mesh import make_mesh
+    from iterative_cleaner_tpu.parallel.sharded import sharded_clean
+    from iterative_cleaner_tpu.service.results_cache import ResultCache
+
+    k = int(os.environ.get("BENCH_COALESCE_K", 8))
+    # The smoke/test small-cube class: small enough that per-dispatch
+    # overhead is the cost being amortized (the campaign workload this
+    # tier exists for), big enough that the loop genuinely iterates.
+    nsub, nchan, nbin = 4, 16, 64
+    cfg = CleanConfig(backend="jax", max_iter=3)
+    cfg_np = CleanConfig(backend="numpy", max_iter=3)
+    mesh = make_mesh()
+    cubes = []
+    for j in range(k):
+        D, w0 = preprocess(make_archive(nsub=nsub, nchan=nchan, nbin=nbin,
+                                        seed=9000 + j))
+        cubes.append((D, w0))
+    Db = np.stack([c[0] for c in cubes])
+    w0b = np.stack([c[1] for c in cubes])
+
+    # Warm both executables (batch-K and batch-1), then measure.
+    sharded_clean(Db, w0b, cfg, mesh)
+    sharded_clean(cubes[0][0][None], cubes[0][1][None], cfg, mesh)
+
+    def run_batch():
+        return sharded_clean(Db, w0b, cfg, mesh)
+
+    def run_solo():
+        return [sharded_clean(D[None], w0[None], cfg, mesh)
+                for D, w0 in cubes]
+
+    t_batch = _min_time(run_batch, n=3)
+    t_solo = _min_time(run_solo, n=3)
+    _tb, w_batch, _lb, _db = sharded_clean(Db, w0b, cfg, mesh)
+    solo = run_solo()
+    oracle = [clean_cube(D, w0, cfg_np) for D, w0 in cubes]
+    parity_solo = all(np.array_equal(w_batch[j], solo[j][1][0])
+                      for j in range(k))
+    parity_oracle = all(np.array_equal(w_batch[j], oracle[j].weights)
+                        for j in range(k))
+    ratio = t_solo / max(t_batch, 1e-9)
+
+    # The content cache: store each solo result under its cube key, then
+    # time the hit round-trip (lookup + byte-compare) against the
+    # miss cost (one solo clean) — the figure the serving worker's cache
+    # rung banks per duplicate submission.
+    with tempfile.TemporaryDirectory(prefix="ict_bench_cache_") as tmp:
+        rc = ResultCache(k, root=os.path.join(tmp, "rc"))
+        keys = [cas.cube_key(D, w0, cfg) for D, w0 in cubes]
+        for j, (D, w0) in enumerate(cubes):
+            rc.put(keys[j], oracle[j].weights, loops=oracle[j].loops,
+                   converged=oracle[j].converged, rfi_frac=0.0,
+                   termination="", origin_job_id=f"bench-{j}")
+
+        def run_hits():
+            for key in keys:
+                assert rc.get(key) is not None
+
+        t_hit = _min_time(run_hits, n=3) / k
+        hit_identical = all(
+            np.array_equal(rc.get(keys[j])["weights"], oracle[j].weights)
+            for j in range(k))
+        salt_miss = rc.get(cas.cube_key(
+            cubes[0][0], cubes[0][1], cfg.replace(max_iter=4))) is None
+
+    res = {
+        "k": k,
+        "shape": [nsub, nchan, nbin],
+        "warm_batch_s": round(t_batch, 4),
+        "warm_solo_total_s": round(t_solo, 4),
+        "jobs_per_s_batched": round(k / max(t_batch, 1e-9), 2),
+        "jobs_per_s_solo": round(k / max(t_solo, 1e-9), 2),
+        "throughput_ratio": round(ratio, 3),
+        "parity_coalesced_vs_solo": bool(parity_solo),
+        "parity_coalesced_vs_oracle": bool(parity_oracle),
+        "cache": {
+            "hit_roundtrip_s": round(t_hit, 6),
+            "miss_clean_s": round(t_solo / k, 4),
+            "hit_speedup": round((t_solo / k) / max(t_hit, 1e-9), 1),
+            "parity_cache_hit_identical": bool(hit_identical),
+            "salt_invalidation_misses": bool(salt_miss),
+            "counters": cas.cache_report(),
+        },
+    }
+    log(f"[coalesce] k={k} batched {t_batch:.3f}s vs solo {t_solo:.3f}s "
+        f"-> {ratio:.2f}x jobs/s (parity solo={parity_solo} "
+        f"oracle={parity_oracle}); cache hit {t_hit * 1e3:.2f}ms vs "
+        f"clean {t_solo / k * 1e3:.0f}ms (identical={hit_identical})")
+    return res
+
+
 def _bench_static_analysis() -> dict:
     """XLA's own static accounting of the benchmark executables on THIS
     backend, via the AOT path (ShapeDtypeStruct avals — no device buffers
@@ -1214,6 +1335,22 @@ def run_bench() -> dict:
         ing = _PAYLOAD.get("ingest", {})
         if isinstance(ing, dict) and "overlap_efficiency" in ing:
             _PAYLOAD["overlap_efficiency"] = ing["overlap_efficiency"]
+
+    if os.environ.get("BENCH_SKIP_COALESCE", "0") == "0":
+        # The coalescing/content-cache arm runs at EVERY config (its own
+        # small K-cube batch, independent of config A) — the payload
+        # contract requires its block and throughput ratio (the gate
+        # fails loudly on an errored section).
+        run_section("coalesce", _bench_coalesce)
+        co = _PAYLOAD.get("coalesce", {})
+        if isinstance(co, dict) and "throughput_ratio" in co:
+            _PAYLOAD["coalesce_throughput_ratio"] = co["throughput_ratio"]
+        elif isinstance(co, dict) and co.get("error"):
+            # The errored block still carries whatever the counters
+            # accumulated (the _headline degraded-block shape).
+            from iterative_cleaner_tpu.ingest import cas as _cas
+
+            co.setdefault("cache", {"counters": _cas.cache_report()})
 
     # --- config B: the north-star shape class ---
     # Runs BEFORE the chunked arm: the r03 interim run lost config B to a

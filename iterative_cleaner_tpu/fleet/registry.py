@@ -217,6 +217,11 @@ class ReplicaRegistry:
                 "warm_shapes": list(r.health.get("warm_shapes", [])),
                 "backend": r.health.get("backend", ""),
                 "version": r.health.get("version", ""),
+                # The content-cache salt (ingest/cas.py) this replica
+                # advertises: the router's fleet-wide result index only
+                # answers when every candidate agrees on it
+                # (fleet/cache.unanimous_salt).
+                "cache_salt": r.health.get("cache_salt", ""),
                 # Correctness-health passthrough: the router's incident
                 # watch keys audit-divergence/demotion bundles off these
                 # (fleet/obs.py), and /healthz readers gate on them the

@@ -52,6 +52,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from iterative_cleaner_tpu.fleet import alerts as fleet_alerts
 from iterative_cleaner_tpu.fleet import autoscale as fleet_autoscale
+from iterative_cleaner_tpu.fleet import cache as fleet_cache
 from iterative_cleaner_tpu.fleet import capacity as fleet_capacity
 from iterative_cleaner_tpu.fleet import history as fleet_history
 from iterative_cleaner_tpu.fleet import obs as fleet_obs
@@ -91,6 +92,19 @@ STRAGGLER_PENALTY = 4.0
 #: lost (its replica restarted with a cleared spool and genuinely does
 #: not know the job) and failed terminally.
 MISSING_POLLS_LOST = 3
+
+#: Default ceiling on the file size the fleet cache will hash at
+#: placement time (the check runs synchronously in the HTTP handler);
+#: ``ICT_FLEET_CACHE_MAX_BYTES`` overrides.
+FLEET_CACHE_MAX_BYTES = 256 << 20
+
+
+def _fleet_cache_max_bytes() -> int:
+    try:
+        return int(os.environ.get("ICT_FLEET_CACHE_MAX_BYTES",
+                                  FLEET_CACHE_MAX_BYTES))
+    except ValueError:
+        return FLEET_CACHE_MAX_BYTES
 
 
 class FleetBusy(RuntimeError):
@@ -190,6 +204,10 @@ class Placement:
     # stitch a failed-over job's telemetry from BOTH replicas
     # (fleet/obs.py; mutated only under the router's placement lock).
     hops: list = field(default_factory=list)
+    # Fleet-cache hits are placements born terminal: the recorded result
+    # summary is served directly by job_manifest (no replica proxy, the
+    # origin replica may be long gone) — None for ordinary placements.
+    cached: dict | None = None
     missing_polls: int = 0          # consecutive status polls the serving
                                     # replica answered 404 — a revived
                                     # replica whose spool was cleared has
@@ -398,6 +416,13 @@ class FleetRouter:
                     down_polls=cfg.scale_down_polls,
                     idle_utilization=cfg.scale_idle_util,
                     cooldown_s=cfg.scale_cooldown_s))
+        # The fleet-wide content-addressed result index (fleet/cache.py;
+        # ROADMAP item 2's reuse half): learned from the terminal
+        # manifests the status polls already observe, checked at
+        # placement time so byte-identical resubmissions return without
+        # touching any replica.  Owns its own lock, acquired strictly
+        # after the router's, never while calling out.
+        self.result_index = fleet_cache.FleetResultIndex()
         # Last observed (audit_divergences, backend) per replica: the
         # incident watch fires a bundle when divergences move or a
         # replica demotes jax -> numpy between polls.
@@ -1097,6 +1122,9 @@ class FleetRouter:
         if known is not None:
             return known
         try:
+            cached = self._resolve_cached(payload, tenant, trace_id, key)
+            if cached is not None:
+                return cached
             return self._place_fresh(payload, tenant, trace_id, key)
         except BaseException:
             self._drop_idem_reservation(key)
@@ -1134,6 +1162,114 @@ class FleetRouter:
         with self._lock:
             if key and self._idem_index.get(key) == "":
                 del self._idem_index[key]
+
+    def _resolve_cached(self, payload: dict, tenant: str, trace_id: str,
+                        key: str) -> dict | None:
+        """Fleet-wide content-addressed reuse, checked at placement time
+        (fleet/cache.py): hash the submitted file's bytes and, when every
+        alive candidate replica advertises the same config/version salt,
+        answer a recorded byte-identical submission with its finished
+        result — a fleet job born terminal.  No quota, no WFQ grant, no
+        placement, and deliberately NO demand counted toward the
+        capacity model: a cache hit consumes no fleet capacity.  Returns
+        the 202 body to serve, or None to place normally."""
+        if payload.get("audit") or payload.get("profile"):
+            # An explicit per-job audit (shadow-oracle replay) or
+            # profiler capture needs a replica: answering from the cache
+            # would silently skip the very check the submitter asked for
+            # (the replica-side tier honors audit-on-hit; the router
+            # tier cannot).
+            self.metrics.count("fleet_cache_skips_total",
+                               {"reason": "per_job_flags"})
+            return None
+        if len(self.result_index) == 0:
+            return None       # cold index: don't pay the file hash
+        try:
+            size = os.path.getsize(str(payload.get("path", "") or ""))
+        except OSError:
+            return None
+        if size > _fleet_cache_max_bytes():
+            # Bound the placement-path I/O: hashing runs synchronously in
+            # the HTTP handler, and a campaign of huge unique archives
+            # would pay a full extra file read per submission for mostly
+            # misses.  The reuse tier targets small-cube campaign
+            # traffic; big cubes place normally.
+            self.metrics.count("fleet_cache_skips_total",
+                               {"reason": "file_too_large"})
+            return None
+        salt = fleet_cache.unanimous_salt(self.registry.snapshot())
+        if not salt:
+            # Mixed-salt fleet (mid-rollout) or nobody advertises one:
+            # never guess which config would have served the job.
+            self.metrics.count("fleet_cache_skips_total",
+                               {"reason": "no_unanimous_salt"})
+            return None
+        from iterative_cleaner_tpu.ingest import cas
+
+        digest = cas.file_digest(str(payload.get("path", "") or ""))
+        if not digest:
+            return None
+        entry = self.result_index.lookup(digest, salt)
+        if entry is None:
+            self.metrics.count("fleet_cache_misses_total")
+            return None
+        if not entry.get("out_path") or not os.path.exists(
+                entry["out_path"]):
+            # The recorded output no longer exists (operator archived or
+            # swept the cleaned files; the index outlives them): place
+            # normally so the submission regenerates its output — a
+            # born-terminal manifest pointing at a dead path would be a
+            # lie.  The replica-side cache tier still spares the device
+            # work and writes a fresh output for THIS path.
+            self.metrics.count("fleet_cache_skips_total",
+                               {"reason": "output_missing"})
+            return None
+        origin = entry.pop("origin")
+        # Time-sortable like replica-minted job ids ('{ms:013d}-{hex}'):
+        # _trim_placements evicts the lexically-smallest terminal ids,
+        # and an unsortable prefix would let stale cache stubs outlive
+        # (and crowd out) recent real placements.
+        job_id = f"{int(time.time() * 1000):013d}-fc{uuid.uuid4().hex[:6]}"
+        manifest = {**entry, "path": str(payload.get("path", "") or ""),
+                    "served_by": "fleet-cache", "origin": origin}
+        placement = Placement(
+            job_id=job_id, tenant=tenant, trace_id=trace_id,
+            payload=payload, base_url="",
+            replica_id=origin.get("replica_id", ""),
+            replica_job_id=origin.get("job_id", ""), state="done",
+            submitted_s=time.time(), cached=manifest)
+        with self._lock:
+            self._placements[job_id] = placement
+            if key:
+                self._idem_index[key] = job_id
+        self.metrics.count("fleet_cache_hits_total")
+        # Cube bytes that never moved because of this hit (f32 cube of
+        # the recorded shape) — the campaign-dedupe savings figure.
+        shape = entry.get("shape") or []
+        if shape:
+            nbytes = 4.0
+            for dim in shape:
+                nbytes *= float(dim)
+            self.metrics.count("fleet_cache_bytes_saved_total",
+                               inc=nbytes)
+        self.traces.record(trace_id, "fleet_cache_hit", job_id=job_id,
+                           origin_job_id=origin.get("job_id", ""),
+                           replica_id=origin.get("replica_id", ""),
+                           tenant=tenant)
+        if events.active():
+            events.emit("fleet_cache_hit", trace_id=trace_id,
+                        job_id=job_id,
+                        origin_job_id=origin.get("job_id", ""),
+                        replica_id=origin.get("replica_id", ""),
+                        tenant=tenant)
+        # Deliberately NOT counted in fleet_jobs_completed_total: that
+        # counter is the exactly-once ledger of placements the fleet
+        # actually ran, and the smoke/tests pin it against replica-side
+        # completions; reuse has its own hit/byte counters.
+        return {**manifest, "id": job_id, "state": "done",
+                "tenant": tenant, "trace_id": trace_id,
+                "replica_id": origin.get("replica_id", ""),
+                "router_id": self.router_id}
 
     def _place_fresh(self, payload: dict, tenant: str, trace_id: str,
                      key: str) -> dict:
@@ -1333,6 +1469,12 @@ class FleetRouter:
             p = self._placements.get(job_id)
         if p is None:
             return 404, {"error": "no such job"}
+        if p.cached is not None:
+            # A fleet-cache hit: born terminal, served from the recorded
+            # summary — the origin replica may be gone, no proxy call.
+            return 200, {**p.cached, "id": p.job_id, "state": p.state,
+                         "replica_id": p.replica_id, "tenant": p.tenant,
+                         "trace_id": p.trace_id}
         rep = self.registry.get(p.base_url)
         if p.state == "open" and (rep is None or rep.alive):
             try:
@@ -1386,6 +1528,18 @@ class FleetRouter:
         if state in ("done", "error"):
             self._mark_terminal(p, state,
                                 error=str(manifest.get("error") or ""))
+        if state == "done":
+            # The fleet cache's learning half: every DONE manifest that
+            # carries its content keys (file_digest + cache_salt, stamped
+            # at replica ingest) becomes the recorded answer for the next
+            # byte-identical submission — observed here because the
+            # status polls already fetch these manifests, zero extra
+            # traffic.
+            if self.result_index.record(manifest,
+                                        origin_replica=p.replica_id):
+                self.metrics.replace_gauge_family(
+                    "fleet_cache_entries",
+                    {(): float(len(self.result_index))})
 
     def _mark_terminal(self, p: Placement, state: str,
                        error: str = "") -> None:
@@ -1581,6 +1735,15 @@ class FleetRouter:
             # a load balancer or fleet_top to see "something is firing"
             # without a second request; GET /fleet/alerts has the rest.
             "alerts": self._alerts_summary(),
+            # The fleet result cache (fleet/cache.py): index size and
+            # cumulative hit/miss counters, summarized for fleet_top.
+            "result_cache": {
+                "entries": len(self.result_index),
+                "hits": int(self.metrics.counter_value(
+                    "fleet_cache_hits_total")),
+                "misses": int(self.metrics.counter_value(
+                    "fleet_cache_misses_total")),
+            },
         }
 
     def _alerts_summary(self) -> dict:
@@ -2113,11 +2276,11 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
     from iterative_cleaner_tpu.service.jobs import TERMINAL
 
     def serve_cfg(tag: str, tmp: str, deadline_s: float,
-                  bucket_cap: int = 0) -> ServeConfig:
+                  bucket_cap: int = 0, coalesce: int = 1) -> ServeConfig:
         return ServeConfig(
             spool_dir=os.path.join(tmp, f"spool_{tag}"), port=0,
             replica_id=f"smoke-{tag}", deadline_s=deadline_s,
-            bucket_cap=bucket_cap,
+            bucket_cap=bucket_cap, coalesce=coalesce,
             quiet=True, clean=CleanConfig(backend="jax", quiet=True))
 
     result = {"smoke": "FAIL"}
@@ -2134,7 +2297,12 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
         # router must cover.  Replica b drains fast.
         svc_a = CleaningService(serve_cfg("a", tmp, deadline_s=3600.0,
                                           bucket_cap=8))
-        svc_b = CleaningService(serve_cfg("b", tmp, deadline_s=0.2))
+        # Replica b runs the coalescing rung (bucket_cap 1 x coalesce 2 =
+        # a 2-cube flush threshold): the throughput-tier phase below
+        # submits two same-shape cubes back to back and asserts they
+        # shared ONE dispatch, masks bit-identical throughout.
+        svc_b = CleaningService(serve_cfg("b", tmp, deadline_s=1.0,
+                                          bucket_cap=1, coalesce=2))
         svc_a.start()
         svc_b.start()
         # Hermetic overrides only (the run_smoke idiom): replicas and the
@@ -2301,10 +2469,83 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                          and any(b.get("rule") == "smoke_open_placements"
                                  for b in bundles)
                          and len(history_view["ticks"]) >= 1)
+            # --- the throughput tier (ROADMAP item 2): coalescing +
+            # fleet-wide content-addressed reuse, end to end ---
+            # Two fresh same-shape cubes submitted back to back must
+            # share ONE coalesced dispatch on replica b (bucket_cap 1 x
+            # coalesce 2), each mask bit-identical to its own oracle.
+            def submit(p, extra=None):
+                req = urllib.request.Request(
+                    f"{base}/jobs",
+                    data=json.dumps({"path": p, **(extra or {})}).encode(),
+                    headers={"Content-Type": "application/json"})
+                return json.load(urllib.request.urlopen(req, timeout=30))
+
+            co_paths = []
+            for i in range(2):
+                p2 = os.path.join(tmp, f"coalesce{i}.npz")
+                NpzIO().save(make_archive(nsub=4, nchan=16, nbin=64,
+                                          seed=500 + i), p2)
+                co_paths.append(p2)
+            co_before = tracing.labeled_snapshot()
+            co_jobs = {p2: submit(p2, {"shape": [4, 16, 64]})
+                       for p2 in co_paths}
+            co_states = {}
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                co_states = {p2: json.load(urllib.request.urlopen(
+                    f"{base}/jobs/{j['id']}", timeout=10))
+                    for p2, j in co_jobs.items()}
+                if all(s.get("state") in TERMINAL
+                       for s in co_states.values()):
+                    break
+                time.sleep(0.05)
+            co_delta = {
+                key: val - co_before.get(key, 0.0)
+                for key, val in tracing.labeled_snapshot().items()
+                if key[0] == "coalesce_batch_size_total"}
+            coalesced_dispatches = sum(
+                val for (_fam, labels), val in co_delta.items()
+                if int(dict(labels).get("k", "1")) >= 2)
+            co_masks_ok = all(s.get("state") == "done"
+                              for s in co_states.values())
+            if co_masks_ok:
+                cfg_np = CleanConfig(backend="numpy")
+                for p2 in co_paths:
+                    want, _rfi = finalize_weights(
+                        clean_cube(*preprocess(NpzIO().load(p2)),
+                                   cfg_np).weights, cfg_np)
+                    got = NpzIO().load(co_states[p2]["out_path"])
+                    if not np.array_equal(got.weights, want):
+                        co_masks_ok = False
+            coalesce_ok = coalesced_dispatches >= 1 and co_masks_ok
+            # A byte-identical resubmission (fresh idempotency key, the
+            # original served on ANOTHER placement) must hit the router's
+            # fleet-wide result cache: born terminal, byte-identical
+            # output, and ZERO replica-side work (service_jobs_done does
+            # not move).
+            done_before_dup = tracing.counters_snapshot().get(
+                "service_jobs_done", 0)
+            dup = submit(paths[0])
+            fleet_cache_hits = router.metrics.counter_total(
+                "fleet_cache_hits_total")
+            dup_no_work = (tracing.counters_snapshot().get(
+                "service_jobs_done", 0) == done_before_dup)
+            dup_masks_ok = False
+            if dup.get("state") == "done" and dup.get("out_path"):
+                cfg_np = CleanConfig(backend="numpy")
+                want, _rfi = finalize_weights(
+                    clean_cube(*preprocess(NpzIO().load(paths[0])),
+                               cfg_np).weights, cfg_np)
+                dup_masks_ok = bool(np.array_equal(
+                    NpzIO().load(dup["out_path"]).weights, want))
+            cache_ok = (dup.get("served_by") == "fleet-cache"
+                        and fleet_cache_hits >= 1 and dup_no_work
+                        and dup_masks_ok)
             ok = (all_done and masks_ok and failovers >= 1
                   and done_delta == len(paths)
                   and fleet_ok and trace_ok and len(incidents) >= 1
-                  and alerts_ok
+                  and alerts_ok and coalesce_ok and cache_ok
                   and health_b.get("audits_run", 0) >= 1
                   and health_b.get("audit_divergences", 0) == 0)
             result = {
@@ -2322,6 +2563,10 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                 "alerts_fired": int(alert_fired),
                 "alert_bundles": len(bundles),
                 "history_ticks": len(history_view["ticks"]),
+                "coalesced_dispatches": int(coalesced_dispatches),
+                "coalesce_masks_ok": bool(co_masks_ok),
+                "fleet_cache_hits": int(fleet_cache_hits),
+                "fleet_cache_hit_ok": bool(cache_ok),
                 "audits_run": health_b.get("audits_run", 0),
                 "audit_divergences": health_b.get("audit_divergences", 0),
                 "placements": {

@@ -1,0 +1,114 @@
+"""Content addressing for cleaned results (the ingest half of the
+throughput tier, ROADMAP item 2).
+
+Reprocessing campaigns resubmit byte-identical archives by the thousand
+(DDF-Pipeline-style reruns, arXiv:2509.03075); cleaning is deterministic,
+so a resubmission's mask is already known the moment its bytes hash the
+same.  This module owns the two hashes that make that reuse safe:
+
+- :func:`cube_key` -- the **canonical content address** of one cleaning
+  problem: SHA-256 over the preprocessed cube bytes (``D`` and ``w0``,
+  shape/dtype framed so concatenation ambiguity cannot alias two
+  problems) plus the :func:`cache_salt`.  Computed at ingest (the loader
+  just decoded the cube anyway) and checked replica-side in the dispatch
+  worker (service/results_cache.py) -- two different files holding the
+  same cube dedupe here.
+- :func:`file_digest` -- a plain SHA-256 of the archive file's raw
+  bytes, no salt.  The fleet router cannot decode archives at placement
+  time, but it can hash the submitted file cheaply; paired with the
+  replicas' advertised :func:`cache_salt` it keys the router's
+  fleet-wide result index (fleet/cache.py), so byte-identical
+  resubmissions return without touching any replica's device.
+
+**Invalidation is the salt.**  :func:`cache_salt` hashes the package
+version together with every mask-affecting ``CleanConfig`` field
+(thresholds, iteration cap, pulse region, bad-parts policy).  A code
+upgrade or a config change yields a different salt, hence different
+keys, hence clean misses -- stale entries are never *wrong*, only
+unreachable, and the bounded LRU sweeps them out.  Route-selection
+fields (``backend``/``fused``/``pallas``/``chunk_block``/...) are
+deliberately NOT salted: masks are bit-identical across every execution
+mode by the repo's core invariant (docs/PARITY.md), so a result cleaned
+on one route answers a resubmission routed anywhere.  ``ICT_CACHE_SALT``
+folds an operator-chosen extra salt in (the manual flush knob).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+from iterative_cleaner_tpu.obs import tracing
+
+#: CleanConfig fields that can change the served mask (or the served
+#: output archive's contents) -- the salt covers exactly these.  The
+#: output-policy fields ride along because the cached record is reused
+#: to WRITE an output archive: two configs that mask identically but
+#: pscrunch differently must not share cache entries.
+_SALT_FIELDS = (
+    "chanthresh", "subintthresh", "max_iter", "pulse_region",
+    "bad_chan", "bad_subint", "pscrunch", "output",
+)
+
+
+def cache_salt(cfg) -> str:
+    """Hex salt naming (version, mask-relevant config, operator salt) --
+    equal salts mean "a cached mask from there answers here"."""
+    from iterative_cleaner_tpu import __version__
+
+    h = hashlib.sha256()
+    h.update(__version__.encode())
+    for name in _SALT_FIELDS:
+        h.update(f"|{name}={getattr(cfg, name)!r}".encode())
+    extra = os.environ.get("ICT_CACHE_SALT", "")
+    if extra:
+        h.update(b"|salt=" + extra.encode())
+    return h.hexdigest()[:16]
+
+
+def _frame(h, arr: np.ndarray) -> None:
+    """Hash one array self-describingly: dtype + shape + C-order bytes,
+    so (D, w0) pairs of different splits can never collide by
+    concatenation."""
+    arr = np.ascontiguousarray(arr)
+    h.update(f"|{arr.dtype.str}{arr.shape}|".encode())
+    h.update(arr.tobytes())
+
+
+def cube_key(D: np.ndarray, w0: np.ndarray, cfg) -> str:
+    """The content address of one cleaning problem: preprocessed cube
+    bytes + weights + :func:`cache_salt`."""
+    h = hashlib.sha256()
+    h.update(cache_salt(cfg).encode())
+    _frame(h, D)
+    _frame(h, w0)
+    return h.hexdigest()
+
+
+def file_digest(path: str) -> str:
+    """Plain SHA-256 of the file's raw bytes (streamed; '' on any read
+    error -- content addressing is an optimization, never a failure
+    mode)."""
+    h = hashlib.sha256()
+    try:
+        with open(path, "rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                h.update(chunk)
+    except OSError:
+        return ""
+    return h.hexdigest()
+
+
+def cache_report() -> dict:
+    """Cumulative result-cache counters out of the process-global
+    registry -- the degraded ``coalesce.cache`` block bench.py's
+    error/watchdog paths fall back to (the ingest.stats_report
+    pattern)."""
+    snap = tracing.counters_snapshot()
+    return {
+        "hits": int(snap.get("service_result_cache_hits", 0)),
+        "misses": int(snap.get("service_result_cache_misses", 0)),
+        "bytes_saved": int(snap.get("service_result_cache_bytes_saved", 0)),
+    }

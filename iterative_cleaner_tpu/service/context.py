@@ -96,6 +96,21 @@ class ReplicaContext:
         self.profile_root = os.path.join(serve_cfg.spool_dir, "profiles")
         self.flight_dir = os.path.join(serve_cfg.spool_dir, "flight")
         self.repro_dir = os.path.join(serve_cfg.spool_dir, "repro")
+        # Content-addressed result cache (service/results_cache.py; keys
+        # from ingest/cas.py): per-replica by construction — fleet tests
+        # run several replicas per process, and one replica's cache must
+        # not answer for another's config.  Persisted next to the job
+        # index so a restart keeps answering yesterday's campaign.
+        from iterative_cleaner_tpu.ingest import cas
+        from iterative_cleaner_tpu.service.results_cache import ResultCache
+
+        self.result_cache = ResultCache(
+            getattr(serve_cfg, "result_cache", 0),
+            root=os.path.join(serve_cfg.spool_dir, "results-cache"))
+        # The replica's config/version salt, advertised on /healthz and
+        # stamped on every manifest: the fleet router's cache only
+        # answers a submission when every candidate replica agrees on it.
+        self.cache_salt = cas.cache_salt(self.clean_cfg)
         # The shadow auditor; assigned once by the daemon during start(),
         # before any worker thread runs.
         self.auditor = None

@@ -60,6 +60,15 @@ class ServeConfig:
                                      # 202 (docs/SERVING.md "Fleet");
                                      # "" = mint one per process life
     bucket_cap: int = 0              # 0 = the mesh's dp extent
+    coalesce: int = 1                # coalescing rung (ROADMAP item 2):
+                                     # pow2 factor on the flush threshold —
+                                     # one dispatch packs dp_cap x coalesce
+                                     # same-shape cubes, each device
+                                     # vmapping `coalesce` archives
+    result_cache: int = 256          # content-addressed result cache
+                                     # entries kept per replica (0 = off;
+                                     # ingest/cas.py keys, persisted under
+                                     # <spool>/results-cache)
     deadline_s: float = 2.0          # max wait before a partial bucket flushes
     loaders: int = 2
     warm_shapes: tuple = ()          # (nsub, nchan, nbin) classes to precompile
@@ -221,7 +230,8 @@ class CleaningService:
             cap = self.serve_cfg.bucket_cap or max(
                 int(self.ctx.mesh.shape["dp"]), 1)
         self.scheduler = ShapeBucketScheduler(
-            cap, self.serve_cfg.deadline_s, self._on_flush)
+            cap, self.serve_cfg.deadline_s, self._on_flush,
+            coalesce=self.serve_cfg.coalesce)
         # The pow2 clamp lives in the scheduler (the mechanism that owns
         # the invariant); the warm pool reads the clamped value so the
         # precompiled batch-size set matches the sizes actually emitted.
@@ -495,6 +505,16 @@ class CleaningService:
             "bucket_queue_depths": (self.scheduler.pending_by_bucket()
                                     if self.scheduler else {}),
             "bucket_cap": self.bucket_cap,
+            "coalesce": (self.scheduler.coalesce if self.scheduler
+                         else self.serve_cfg.coalesce),
+            # The content-cache identity + size: the fleet router only
+            # serves a cached result when every candidate replica
+            # advertises the SAME salt (fleet/cache.py; advertised even
+            # with the replica-local tier off — the router tier is its
+            # own knob), and fleet_top's cache columns read the entry
+            # counts next to the hit/miss counters on /metrics.
+            "cache_salt": self.ctx.cache_salt,
+            "result_cache_entries": len(self.ctx.result_cache),
             "deadline_s": self.serve_cfg.deadline_s,
             "warm_shapes": (self.pool.warm_shapes_now() if self.pool else []),
             "open_sessions": (self.sessions.open_count()
@@ -543,6 +563,25 @@ class CleaningService:
                 # fails ALONE, before it can join (and take down) a bucket.
                 self.worker._fail(job, f"load failed: {exc}")
                 continue
+            # Content addressing at ingest (ingest/cas.py): the cube key
+            # the worker's result cache checks, and the file digest +
+            # salt the fleet router's placement-time cache learns off the
+            # terminal manifest.  Hashing is one pass over bytes already
+            # resident — noise next to the clean it can save.  The
+            # digest is recomputed HERE even when a router already
+            # hashed the file at placement time, deliberately: the
+            # manifest digest seeds the FLEET-WIDE reuse index, and
+            # accepting a submitter-supplied value would let one buggy
+            # or hostile client map digest(X) -> result(Y) for every
+            # other tenant's byte-identical submission — the replica's
+            # own read is the trust boundary (the cost is bounded by
+            # the router's ICT_FLEET_CACHE_MAX_BYTES skip).
+            from iterative_cleaner_tpu.ingest import cas
+
+            job.cache_salt = self.ctx.cache_salt
+            job.file_digest = cas.file_digest(job.path)
+            if self.ctx.result_cache.enabled:
+                job.content_key = cas.cube_key(D, w0, self.clean_cfg)
             self.scheduler.offer(job, archive, D, w0)
 
     def _tick_loop(self) -> None:
@@ -590,6 +629,23 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--bucket_cap", type=int, default=0, metavar="N",
                    help="archives per sharded dispatch (0 = the mesh's "
                         "data-parallel extent; clamped to a power of two)")
+    p.add_argument("--coalesce", type=int, default=1, metavar="K",
+                   help="request-coalescing factor (clamped to a power of "
+                        "two): a shape bucket flushes at bucket_cap x K "
+                        "cubes, so one batched executable launch amortizes "
+                        "over K cubes per data-parallel slice — the "
+                        "small-cube campaign throughput knob; raises "
+                        "per-device residency by the same factor "
+                        "(default 1; docs/SERVING.md)")
+    p.add_argument("--result_cache", type=int, default=256, metavar="N",
+                   help="content-addressed result-cache entries kept "
+                        "(0 = off): a resubmitted cube whose bytes + "
+                        "config hash to a known key is served from the "
+                        "cached mask without touching the device, "
+                        "byte-identical by construction; entries persist "
+                        "under <spool>/results-cache and are invalidated "
+                        "by the code-version/config salt "
+                        "(default 256; docs/SERVING.md)")
     p.add_argument("--deadline_s", type=float, default=2.0, metavar="S",
                    help="max seconds a partial bucket waits before it is "
                         "dispatched anyway (default 2.0)")
@@ -668,6 +724,11 @@ def serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
     if args.bucket_cap < 0:
         raise ValueError(f"--bucket_cap must be >= 0 (0 = the mesh's dp "
                          f"extent), got {args.bucket_cap}")
+    if args.coalesce < 1:
+        raise ValueError(f"--coalesce must be >= 1, got {args.coalesce}")
+    if args.result_cache < 0:
+        raise ValueError(f"--result_cache must be >= 0 (0 = off), "
+                         f"got {args.result_cache}")
     if args.alert_iters < 1:
         raise ValueError(f"--alert_iters must be >= 1, got {args.alert_iters}")
     if args.audit_rate > 1:
@@ -680,6 +741,8 @@ def serve_config_from_args(args: argparse.Namespace) -> ServeConfig:
         port=args.port,
         replica_id=args.replica_id,
         bucket_cap=args.bucket_cap,
+        coalesce=args.coalesce,
+        result_cache=args.result_cache,
         deadline_s=args.deadline_s,
         loaders=args.loaders,
         spool_keep=args.spool_keep,

@@ -57,6 +57,19 @@ class Job:
     audit: bool = False             # submitter asked for a shadow-oracle
                                     # parity audit of this job (obs/audit.py;
                                     # ICT_AUDIT_RATE samples the rest)
+    content_key: str = ""           # content address of the cleaning
+                                    # problem (ingest/cas.cube_key:
+                                    # preprocessed cube bytes + config/
+                                    # version salt), stamped at ingest —
+                                    # the replica-side result cache's key
+    file_digest: str = ""           # plain SHA-256 of the archive file's
+                                    # raw bytes (ingest/cas.file_digest) —
+                                    # the fleet router's placement-time
+                                    # cache key, paired with cache_salt
+    cache_salt: str = ""            # the serving replica's config/version
+                                    # salt (ingest/cas.cache_salt): a
+                                    # cached result only answers
+                                    # submissions under the same salt
     idem_key: str = ""              # submitter-supplied idempotency key
                                     # (the fleet router's failover path):
                                     # a re-submission carrying the same key

@@ -1,20 +1,30 @@
-"""Shape-bucketed admission: fill a data-parallel slice or hit a deadline.
+"""Shape-bucketed admission: fill a coalesced dp slice or hit a deadline.
 
 The batching rules are the ones `parallel/batch.py` established for
 directories, applied to a continuous arrival stream:
 
-- same-shape cubes stack into ONE sharded dispatch (one archive per dp
-  slice; zero-weight padding is never used — it would perturb the
-  mask-blind FFT diagnostic, see parallel/sharded.py);
-- a bucket flushes the moment it holds ``bucket_cap`` cubes (default: the
-  mesh's dp extent — a full data-parallel slice), or when its OLDEST entry
-  has waited ``deadline_s`` (latency bound for sparse traffic);
+- same-shape cubes stack into ONE sharded dispatch (zero-weight padding
+  is never used — it would perturb the mask-blind FFT diagnostic, see
+  parallel/sharded.py);
+- the **coalescing rung** (ROADMAP item 2's throughput half): the flush
+  threshold is ``dp_cap x coalesce`` cubes — one data-parallel slice
+  times a pow2 coalesce factor — so ONE ``batched_fused_clean`` launch
+  amortizes over K cubes, each device vmapping ``coalesce`` archives of
+  its slice.  ``coalesce=1`` (the default) is the historical
+  one-archive-per-slice behavior; raising it trades bounded added
+  latency (the deadline still caps the wait) and per-device residency
+  (``coalesce`` cubes live per chip) for launch amortization on
+  small-cube campaign traffic;
+- a bucket flushes the moment it holds ``bucket_cap`` cubes, or when its
+  OLDEST entry has waited ``deadline_s`` (latency bound for sparse
+  traffic);
 - deadline flushes are chunked to power-of-two batch sizes, the
   clean_directory_streaming pressure-flush trick: the batched executable
   specializes on batch size, so pow2 chunking bounds the compile set to
   O(log cap) sizes per shape — exactly the set service/pool.py precompiles
-  at startup, which is what makes "an already-warm shape never compiles"
-  hold for partial buckets too.
+  at startup (dp_cap and coalesce are each pow2-clamped, so their product
+  keeps the warm-pool key set closed), which is what makes "an
+  already-warm shape never compiles" hold for partial buckets too.
 
 The scheduler owns no threads: the daemon's loader threads call
 :meth:`offer` and a tick loop calls :meth:`tick`; ``flush_fn(entries)``
@@ -33,6 +43,14 @@ from iterative_cleaner_tpu.io.base import Archive
 from iterative_cleaner_tpu.obs import events, tracing
 from iterative_cleaner_tpu.service.jobs import Job
 
+#: Canonical shape-bucket label, ``8x16x64`` — ONE grammar shared by the
+#: ``--warm`` CLI spec, ``/healthz`` bucket depths, the fleet router's
+#: placement keys, and compile-scope attribution.  The implementation
+#: lives in obs/tracing.py (the lowest layer that needs it); this alias
+#: is the name the service/fleet tier imports, so the two spellings can
+#: never drift apart again (tests/test_coalesce.py pins the unification).
+bucket_label = tracing.shape_bucket_label
+
 
 @dataclass
 class Entry:
@@ -43,13 +61,6 @@ class Entry:
     D: np.ndarray
     w0: np.ndarray
     arrived_s: float            # time.monotonic() — immune to clock steps
-
-
-def bucket_label(shape) -> str:
-    """Canonical shape-bucket label, ``8x16x64`` — one grammar shared by
-    the ``--warm`` CLI spec, ``/healthz`` bucket depths, and the fleet
-    router's placement keys."""
-    return "x".join(str(int(v)) for v in shape)
 
 
 def pow2_chunks(n: int, cap: int) -> list[int]:
@@ -66,14 +77,23 @@ def pow2_chunks(n: int, cap: int) -> list[int]:
 
 
 class ShapeBucketScheduler:
-    def __init__(self, bucket_cap: int, deadline_s: float, flush_fn) -> None:
+    def __init__(self, bucket_cap: int, deadline_s: float, flush_fn,
+                 coalesce: int = 1) -> None:
         if bucket_cap < 1:
             raise ValueError(f"bucket_cap must be >= 1, got {bucket_cap}")
-        # Clamp to a power of two HERE, in the mechanism that owns the
+        if coalesce < 1:
+            raise ValueError(f"coalesce must be >= 1, got {coalesce}")
+        # Clamp to powers of two HERE, in the mechanism that owns the
         # invariant: full-bucket flushes emit exactly bucket_cap entries
         # unchunked, and the warm pool only precompiles pow2 batch sizes —
-        # a cap of 3 would dispatch batches no warm set covers.
-        self.bucket_cap = 1 << (int(bucket_cap).bit_length() - 1)
+        # a cap of 3 would dispatch batches no warm set covers.  dp_cap
+        # and coalesce are clamped separately so their product (the
+        # effective flush threshold) stays pow2 AND dp-divisible: a full
+        # coalesced batch shards evenly over the mesh's dp axis, each
+        # device vmapping `coalesce` archives.
+        self.dp_cap = 1 << (int(bucket_cap).bit_length() - 1)
+        self.coalesce = 1 << (int(coalesce).bit_length() - 1)
+        self.bucket_cap = self.dp_cap * self.coalesce
         self.deadline_s = float(deadline_s)
         self._flush_fn = flush_fn
         self._buckets: dict[tuple, list[Entry]] = {}  # ict: guarded-by(self._lock)

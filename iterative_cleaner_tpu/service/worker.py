@@ -44,7 +44,7 @@ from iterative_cleaner_tpu.obs import (
     tracing,
 )
 from iterative_cleaner_tpu.service.jobs import TERMINAL, Job
-from iterative_cleaner_tpu.service.scheduler import Entry
+from iterative_cleaner_tpu.service.scheduler import Entry, bucket_label
 from iterative_cleaner_tpu.utils import backoff
 
 _STOP = object()
@@ -85,6 +85,12 @@ class DispatchWorker(threading.Thread):
 
     def _dispatch(self, entries: list[Entry]) -> None:
         ctx = self.ctx
+        # The content-cache rung runs FIRST: a cube whose bytes + config
+        # hash to a known key is served from the cached mask — the
+        # sibling misses still share one coalesced dispatch below.
+        entries = self._serve_cached(entries)
+        if not entries:
+            return
         for e in entries:
             e.job.state = "running"
             ctx.spool.save(e.job)
@@ -106,6 +112,56 @@ class DispatchWorker(threading.Thread):
                 for e in want_profile:
                     e.job.profile_dir = profile_dir
             self._dispatch_routed(entries)
+
+    def _serve_cached(self, entries: list[Entry]) -> list[Entry]:
+        """Content-addressed reuse (service/results_cache.py, keys from
+        ingest/cas.py): serve every entry whose cube key has a cached
+        mask — byte-identical to a fresh clean by construction (the key
+        covers cube bytes + config + code version) with zero device
+        work — and return the misses for the coalesced dispatch.  A hit
+        is only shadow-audited on explicit request (``{"audit": true}``
+        replays the oracle against the cached mask); sampled audits stay
+        on the freshly-cleaned routes."""
+        ctx = self.ctx
+        if not ctx.result_cache.enabled:
+            return entries
+        misses: list[Entry] = []
+        for e in entries:
+            if e.job.state in TERMINAL:
+                continue
+            bucket = bucket_label(e.D.shape)
+            rec = (ctx.result_cache.get(e.job.content_key)
+                   if e.job.content_key else None)
+            if rec is None:
+                tracing.count("service_result_cache_misses")
+                tracing.count_labeled("result_cache_total",
+                                      {"outcome": "miss",
+                                       "shape_bucket": bucket})
+                misses.append(e)
+                continue
+            e.job.state = "running"
+            ctx.spool.save(e.job)
+            tracing.count("service_result_cache_hits")
+            tracing.count_labeled("result_cache_total",
+                                  {"outcome": "hit",
+                                   "shape_bucket": bucket})
+            # Bytes that never crossed to (or through) a device because
+            # of this hit — the campaign-dedupe savings figure.
+            tracing.count("service_result_cache_bytes_saved",
+                          float(e.D.nbytes))
+            if events.active():
+                events.emit("dispatch", trace_id=e.job.trace_id,
+                            job_id=e.job.id, bucket_size=1,
+                            backend="cache",
+                            origin_job_id=rec.get("origin_job_id", ""))
+            try:
+                with tracing.phase("service_cache_emit"):
+                    self._emit(e, rec["weights"], rec["loops"],
+                               rec["converged"], rec["rfi_frac"], "cache",
+                               termination=rec.get("termination") or "")
+            except Exception as exc:  # noqa: BLE001 — isolate the one job
+                self._fail(e.job, f"cache-hit emission failed: {exc}")
+        return misses
 
     def _dispatch_routed(self, entries: list[Entry]) -> None:
         ctx = self.ctx
@@ -173,6 +229,17 @@ class DispatchWorker(threading.Thread):
                  for e in entries]
         Db = np.stack([e.D for e in entries])
         w0b = np.stack([e.w0 for e in entries])
+        # Coalescing accounting (the throughput-tier rung): the realized
+        # batch size per shape bucket, as a low-cardinality labeled
+        # counter (k is pow2-bounded by the scheduler, O(log cap) values
+        # per shape) — federated into /fleet/metrics, rendered as a
+        # per-bucket batch-size p50 by tools/fleet_top.py.
+        tracing.count_labeled("coalesce_batch_size_total",
+                              {"shape_bucket": bucket_label(Db.shape[1:]),
+                               "k": str(len(entries))})
+        if len(entries) > 1:
+            tracing.count("service_coalesced_dispatches")
+            tracing.count("service_coalesced_jobs", float(len(entries)))
 
         emit_s = [0.0]
 
@@ -295,6 +362,15 @@ class DispatchWorker(threading.Thread):
         job.quality = obs_quality.quality_summary(
             np.asarray(weights), termination=termination)
         obs_quality.record_job_quality(job.quality, timeline=job.timeline)
+        # Store-through into the content cache: every freshly-cleaned
+        # result (sharded or oracle — masks are identical by the parity
+        # invariant) becomes the answer for the next byte-identical
+        # submission.  Cache-served jobs are not re-stored.
+        if served_by != "cache" and job.content_key:
+            ctx.result_cache.put(
+                job.content_key, np.asarray(weights), loops=job.loops,
+                converged=job.converged, rfi_frac=job.rfi_frac,
+                termination=termination, origin_job_id=job.id)
         # Shadow-oracle audit (obs/audit.py): sampled (ICT_AUDIT_RATE) or
         # per-job requested jobs are offered to the background auditor
         # BEFORE the terminal transition below, so "every job is terminal"

@@ -1,0 +1,486 @@
+"""Throughput tier end to end (ISSUE 13): request coalescing + the
+content-addressed result cache.
+
+The acceptance contract: K mixed-seed same-shape jobs packed through the
+scheduler's coalescing rung share ONE stacked dispatch with every mask
+bit-identical to its own numpy oracle; a byte-identical resubmission is
+served from the result cache — byte-identical output, zero device
+dispatch — replica-side, across a daemon restart (spool persistence),
+and fleet-wide through the router's placement-time index; and the
+code-version/config salt invalidates cleanly.  The shape-bucket grammar
+unification (scheduler.bucket_label == tracing.shape_bucket_label) is
+pinned here too.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.request
+
+import jax
+import numpy as np
+
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.core.cleaner import clean_cube
+from iterative_cleaner_tpu.fleet.cache import FleetResultIndex, unanimous_salt
+from iterative_cleaner_tpu.ingest import cas
+from iterative_cleaner_tpu.io.npz import NpzIO
+from iterative_cleaner_tpu.io.synthetic import make_archive
+from iterative_cleaner_tpu.ops.preprocess import preprocess
+from iterative_cleaner_tpu.parallel.batch import finalize_weights
+from iterative_cleaner_tpu.parallel.mesh import make_mesh
+from iterative_cleaner_tpu.service import CleaningService, ServeConfig
+from iterative_cleaner_tpu.service.jobs import TERMINAL, Job
+from iterative_cleaner_tpu.service.results_cache import ResultCache
+from iterative_cleaner_tpu.service.scheduler import (
+    ShapeBucketScheduler,
+    bucket_label,
+)
+from iterative_cleaner_tpu.utils import tracing
+
+
+def _write(tmp_path, name, nsub=4, seed=0):
+    p = str(tmp_path / name)
+    NpzIO().save(make_archive(nsub=nsub, nchan=16, nbin=64, seed=seed), p)
+    return p
+
+
+def _oracle_weights(path, max_iter=3):
+    cfg = CleanConfig(backend="numpy", max_iter=max_iter)
+    w, _rfi = finalize_weights(
+        clean_cube(*preprocess(NpzIO().load(path)), cfg).weights, cfg)
+    return w
+
+
+def _start(tmp_path, **kw):
+    mesh = make_mesh(8, devices=jax.devices("cpu"))
+    defaults = dict(spool_dir=str(tmp_path / "spool"), port=0,
+                    deadline_s=0.2, quiet=True, retry_backoff_s=0.01,
+                    clean=CleanConfig(backend="jax", max_iter=3, quiet=True,
+                                      no_log=True))
+    defaults.update(kw)
+    svc = CleaningService(ServeConfig(**defaults), mesh=mesh)
+    svc.start()
+    return svc
+
+
+def _post_job(port, path, **extra):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/jobs",
+        data=json.dumps({"path": path, **extra}).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.load(urllib.request.urlopen(req, timeout=30))
+
+
+def _wait_done(port, job_ids, timeout=120):
+    deadline = time.time() + timeout
+    states = {}
+    while time.time() < deadline:
+        states = {jid: json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/jobs/{jid}", timeout=30))
+            for jid in job_ids}
+        if all(s["state"] in TERMINAL for s in states.values()):
+            return states
+        time.sleep(0.05)
+    raise AssertionError(f"jobs not terminal in {timeout}s: "
+                         f"{ {j: s.get('state') for j, s in states.items()} }")
+
+
+# --- satellite: the unified shape-bucket grammar ---
+
+class TestBucketGrammar:
+    def test_one_shared_helper(self):
+        # The two historical spellings are now literally one function —
+        # registry/router placement keys, /healthz depths, --warm specs,
+        # and compile-scope attribution cannot drift apart again.
+        assert bucket_label is tracing.shape_bucket_label
+
+    def test_rendered_labels_unchanged(self):
+        # The regression pin: every label either implementation ever
+        # rendered, byte-for-byte.
+        for shape, want in [((8, 16, 64), "8x16x64"),
+                            ((256, 1024, 1024), "256x1024x1024"),
+                            ((2, 8, 16, 64), "2x8x16x64"),   # batch-keyed
+                            ((4.0, 16.0, 64.0), "4x16x64")]:
+            assert bucket_label(shape) == want
+            assert tracing.shape_bucket_label(shape) == want
+
+
+# --- the coalescing rung ---
+
+class TestCoalesceScheduler:
+    def test_effective_cap_is_dp_cap_times_coalesce(self):
+        s = ShapeBucketScheduler(2, 1.0, lambda e: None, coalesce=4)
+        assert (s.dp_cap, s.coalesce, s.bucket_cap) == (2, 4, 8)
+
+    def test_both_factors_pow2_clamped(self):
+        s = ShapeBucketScheduler(3, 1.0, lambda e: None, coalesce=3)
+        assert (s.dp_cap, s.coalesce, s.bucket_cap) == (2, 2, 4)
+
+    def test_default_coalesce_is_historical_behavior(self):
+        s = ShapeBucketScheduler(8, 1.0, lambda e: None)
+        assert s.coalesce == 1 and s.bucket_cap == 8
+
+    def test_rejects_bad_coalesce(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            ShapeBucketScheduler(2, 1.0, lambda e: None, coalesce=0)
+
+    def test_full_coalesced_bucket_flushes_unchunked(self):
+        flushed = []
+        s = ShapeBucketScheduler(2, 999.0, flushed.append, coalesce=2)
+        D = np.zeros((4, 3, 8), np.float32)
+        for _ in range(4):
+            s.offer(Job(id="j", path="x"), None, D,
+                    np.zeros((4, 3), np.float32))
+        assert [len(g) for g in flushed] == [4]
+
+
+def test_coalesced_dispatch_masks_bit_identical(tmp_path):
+    """K=4 mixed-seed same-shape jobs through the scheduler rung: ONE
+    stacked dispatch (the k=4 batch-size counter moves exactly once),
+    each mask bit-identical to its own numpy oracle."""
+    paths = [_write(tmp_path, f"a{i}.npz", seed=40 + i) for i in range(4)]
+    svc = _start(tmp_path, bucket_cap=2, coalesce=2, deadline_s=5.0)
+    try:
+        assert svc.bucket_cap == 4  # dp_cap 2 x coalesce 2
+        before = tracing.labeled_snapshot()
+        jobs = {p: _post_job(svc.port, p) for p in paths}
+        states = _wait_done(svc.port, [j["id"] for j in jobs.values()])
+        assert all(s["state"] == "done" for s in states.values())
+        delta = {key: val - before.get(key, 0.0)
+                 for key, val in tracing.labeled_snapshot().items()
+                 if key[0] == "coalesce_batch_size_total"}
+        assert delta.get(("coalesce_batch_size_total",
+                          (("k", "4"), ("shape_bucket", "4x16x64")))) == 1.0
+        for p in paths:
+            got = NpzIO().load(states[jobs[p]["id"]]["out_path"]).weights
+            assert np.array_equal(got, _oracle_weights(p)), p
+    finally:
+        svc.stop()
+
+
+# --- the content-addressed result cache ---
+
+def test_cache_hit_byte_identical_and_skips_dispatch(tmp_path):
+    """A byte-identical resubmission is served from the cache: same
+    output bytes, `served_by: "cache"`, and the device-dispatch counter
+    does not move."""
+    path = _write(tmp_path, "a.npz", seed=7)
+    dup = _write(tmp_path, "dup.npz", seed=7)   # same bytes, another path
+    svc = _start(tmp_path)
+    try:
+        first = _post_job(svc.port, path)
+        s1 = _wait_done(svc.port, [first["id"]])[first["id"]]
+        assert s1["state"] == "done" and s1["served_by"] == "sharded"
+        assert s1["content_key"] and s1["file_digest"] and s1["cache_salt"]
+        snap0 = tracing.counters_snapshot()
+        second = _post_job(svc.port, dup)
+        s2 = _wait_done(svc.port, [second["id"]])[second["id"]]
+        assert s2["state"] == "done" and s2["served_by"] == "cache"
+        snap1 = tracing.counters_snapshot()
+        assert snap1.get("service_dispatch_n", 0) == \
+            snap0.get("service_dispatch_n", 0)
+        assert snap1.get("service_result_cache_hits", 0) == \
+            snap0.get("service_result_cache_hits", 0) + 1
+        assert snap1.get("service_result_cache_bytes_saved", 0) > \
+            snap0.get("service_result_cache_bytes_saved", 0)
+        w1 = NpzIO().load(s1["out_path"]).weights
+        w2 = NpzIO().load(s2["out_path"]).weights
+        assert np.array_equal(w1, w2)
+        assert np.array_equal(w2, _oracle_weights(path))
+        health = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}/healthz", timeout=30))
+        assert health["result_cache_entries"] >= 1
+        assert health["cache_salt"] == s1["cache_salt"]
+    finally:
+        svc.stop()
+
+
+def test_cache_survives_restart_via_spool_persistence(tmp_path):
+    """The disk tier next to the job index: a restarted replica answers
+    yesterday's cube from <spool>/results-cache without a dispatch."""
+    path = _write(tmp_path, "a.npz", seed=9)
+    svc = _start(tmp_path)
+    try:
+        first = _post_job(svc.port, path)
+        _wait_done(svc.port, [first["id"]])
+    finally:
+        svc.stop()
+    svc2 = _start(tmp_path)
+    try:
+        snap0 = tracing.counters_snapshot()
+        again = _post_job(svc2.port, path)
+        s2 = _wait_done(svc2.port, [again["id"]])[again["id"]]
+        assert s2["served_by"] == "cache"
+        assert tracing.counters_snapshot().get("service_dispatch_n", 0) == \
+            snap0.get("service_dispatch_n", 0)
+        assert np.array_equal(NpzIO().load(s2["out_path"]).weights,
+                              _oracle_weights(path))
+    finally:
+        svc2.stop()
+
+
+def test_version_config_salt_invalidation(tmp_path, monkeypatch):
+    """The salt is the invalidation: a config change or an operator salt
+    bump makes every old key unreachable (a fresh clean, not a wrong
+    cached answer)."""
+    D, w0 = preprocess(make_archive(nsub=4, nchan=16, nbin=64, seed=3))
+    cfg = CleanConfig(max_iter=3)
+    base = cas.cube_key(D, w0, cfg)
+    assert cas.cube_key(D, w0, cfg) == base                 # deterministic
+    assert cas.cube_key(D, w0, cfg.replace(max_iter=4)) != base
+    assert cas.cube_key(D, w0, cfg.replace(chanthresh=4)) != base
+    # Route-selection fields are deliberately NOT salted: masks are
+    # bit-identical across execution modes (docs/PARITY.md), so a result
+    # cleaned on any route answers a resubmission routed anywhere.
+    assert cas.cube_key(D, w0, cfg.replace(backend="jax",
+                                           fused=True)) == base
+    monkeypatch.setenv("ICT_CACHE_SALT", "rolled")
+    assert cas.cube_key(D, w0, cfg) != base
+    monkeypatch.delenv("ICT_CACHE_SALT")
+
+    # Service-level: the same cube resubmitted after an operator salt
+    # roll misses (fresh dispatch), never serves the stale entry.
+    path = _write(tmp_path, "a.npz", seed=3)
+    svc = _start(tmp_path)
+    try:
+        first = _post_job(svc.port, path)
+        _wait_done(svc.port, [first["id"]])
+    finally:
+        svc.stop()
+    monkeypatch.setenv("ICT_CACHE_SALT", "rolled")
+    try:
+        svc2 = _start(tmp_path)
+        try:
+            again = _post_job(svc2.port, path)
+            s2 = _wait_done(svc2.port, [again["id"]])[again["id"]]
+            assert s2["state"] == "done" and s2["served_by"] != "cache"
+            assert np.array_equal(NpzIO().load(s2["out_path"]).weights,
+                                  _oracle_weights(path))
+        finally:
+            svc2.stop()
+    finally:
+        monkeypatch.delenv("ICT_CACHE_SALT")
+
+
+def test_result_cache_bounded_and_disabled_modes(tmp_path):
+    rc = ResultCache(0, root=str(tmp_path / "rc"))
+    assert not rc.enabled
+    rc.put("k", np.ones((2, 2), np.float32), loops=1, converged=True,
+           rfi_frac=0.0, termination="")
+    assert rc.get("k") is None and len(rc) == 0
+    rc = ResultCache(2, root=str(tmp_path / "rc2"))
+    for i in range(5):
+        rc.put(f"k{i}", np.ones((2, 2), np.float32), loops=1,
+               converged=True, rfi_frac=0.0, termination="")
+    assert len(rc) == 2
+    # Disk tier bounded at DISK_KEEP_FACTOR x capacity.
+    files = [n for n in os.listdir(str(tmp_path / "rc2"))
+             if n.endswith(".npz")]
+    assert len(files) <= 4
+
+
+# --- the fleet-wide tier ---
+
+class TestFleetIndexUnits:
+    def test_record_requires_keys_and_done(self):
+        idx = FleetResultIndex(capacity=4)
+        assert not idx.record({"state": "done"})
+        assert not idx.record({"state": "error", "file_digest": "d",
+                               "cache_salt": "s"})
+        assert idx.record({"state": "done", "file_digest": "d",
+                           "cache_salt": "s", "out_path": "/x",
+                           "id": "j1"}, origin_replica="r1")
+        hit = idx.lookup("d", "s")
+        assert hit["out_path"] == "/x"
+        assert hit["origin"] == {"job_id": "j1", "replica_id": "r1",
+                                 "served_by": ""}
+        assert idx.lookup("d", "other-salt") is None
+
+    def test_bounded_lru(self):
+        idx = FleetResultIndex(capacity=2)
+        for i in range(4):
+            idx.record({"state": "done", "file_digest": f"d{i}",
+                        "cache_salt": "s", "id": f"j{i}"})
+        assert len(idx) == 2
+        assert idx.lookup("d0", "s") is None
+        assert idx.lookup("d3", "s") is not None
+
+    def test_unanimous_salt_gate(self):
+        rows = [{"alive": True, "draining": False, "cache_salt": "s"},
+                {"alive": True, "draining": False, "cache_salt": "s"},
+                {"alive": False, "draining": False, "cache_salt": "t"},
+                {"alive": True, "draining": True, "cache_salt": "t"}]
+        assert unanimous_salt(rows) == "s"     # dead/draining don't vote
+        rows[1]["cache_salt"] = "t"
+        assert unanimous_salt(rows) == ""      # mixed-salt fleet: skip
+
+
+def test_fleet_cache_serves_duplicate_across_replicas(tmp_path):
+    """The fleet-wide rung: a duplicate submission through the router is
+    answered at placement time from the result index — born terminal,
+    byte-identical output, zero replica-side work — even though the
+    fresh idempotency key rules the idem path out."""
+    import test_fleet
+
+    path = _write(tmp_path, "a.npz", seed=11)
+    a = test_fleet._start_replica(tmp_path, "cache-a")
+    b = test_fleet._start_replica(tmp_path, "cache-b")
+    router = test_fleet._start_router(a, b)
+    try:
+        base = f"http://{router.cfg.host}:{router.port}"
+        first = _post_job(router.port, path)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            router.poll_tick()
+            s1 = json.load(urllib.request.urlopen(
+                f"{base}/jobs/{first['id']}", timeout=30))
+            if s1.get("state") in TERMINAL:
+                break
+            time.sleep(0.05)
+        assert s1["state"] == "done"
+        assert len(router.result_index) == 1
+        done_before = tracing.counters_snapshot().get(
+            "service_jobs_done", 0)
+        dup = _post_job(router.port, path)
+        assert dup["state"] == "done"
+        assert dup["served_by"] == "fleet-cache"
+        assert dup["id"] != first["id"]
+        # Time-sortable like replica-minted ids: _trim_placements evicts
+        # the lexically smallest terminal ids, so an unsortable prefix
+        # would let stale cache stubs crowd out real recent placements.
+        import re
+
+        assert re.fullmatch(r"\d{13}-fc[0-9a-f]{6}", dup["id"]), dup["id"]
+        assert dup["origin"]["job_id"]
+        assert router.metrics.counter_total("fleet_cache_hits_total") == 1
+        # Zero replica work: no replica completed anything for the dup.
+        assert tracing.counters_snapshot().get(
+            "service_jobs_done", 0) == done_before
+        # And the fleet job reads back terminal through the router.
+        readback = json.load(urllib.request.urlopen(
+            f"{base}/jobs/{dup['id']}", timeout=30))
+        assert readback["state"] == "done"
+        assert readback["served_by"] == "fleet-cache"
+        assert np.array_equal(NpzIO().load(readback["out_path"]).weights,
+                              _oracle_weights(path))
+        # A cache hit is not demand: the capacity model saw exactly one
+        # placement-shaped arrival (the original), not two.
+        assert router.metrics.counter_total(
+            "fleet_placements_total") == 1
+        # An explicit per-job audit must reach a replica (the shadow
+        # replay is the point) — the router tier skips the cache.
+        audited = _post_job(router.port, path, audit=True)
+        assert audited.get("served_by") != "fleet-cache"
+        assert router.metrics.counter_value(
+            "fleet_cache_skips_total", {"reason": "per_job_flags"}) == 1
+        _wait_done(router.port, [audited["id"]])
+        # Oversized files place normally instead of paying a synchronous
+        # placement-path hash (ICT_FLEET_CACHE_MAX_BYTES bounds it).
+        os.environ["ICT_FLEET_CACHE_MAX_BYTES"] = "1"
+        try:
+            big = _post_job(router.port, path)
+            assert big.get("served_by") != "fleet-cache"
+            assert router.metrics.counter_value(
+                "fleet_cache_skips_total",
+                {"reason": "file_too_large"}) == 1
+            _wait_done(router.port, [big["id"]])
+        finally:
+            del os.environ["ICT_FLEET_CACHE_MAX_BYTES"]
+        # A recorded output that vanished (operator swept the cleaned
+        # files) falls back to normal placement — a born-terminal
+        # manifest must never point at a dead path; the replica-side
+        # tier regenerates the output without device work.
+        os.rename(readback["out_path"], readback["out_path"] + ".gone")
+        gone = _post_job(router.port, path)
+        assert gone.get("served_by") != "fleet-cache"
+        assert router.metrics.counter_value(
+            "fleet_cache_skips_total", {"reason": "output_missing"}) >= 1
+        s_gone = _wait_done(router.port, [gone["id"]])[gone["id"]]
+        assert s_gone["state"] == "done"
+        assert os.path.exists(s_gone["out_path"])
+    finally:
+        router.stop()
+        a.stop()
+        b.stop()
+
+
+def test_fleet_cache_skips_on_mixed_salt(tmp_path, monkeypatch):
+    """Mid-rollout (replicas advertising different salts) the router
+    must place normally, never guess which config a cached mask came
+    from."""
+    import test_fleet
+
+    path = _write(tmp_path, "a.npz", seed=13)
+    a = test_fleet._start_replica(tmp_path, "salt-a")
+    b = test_fleet._start_replica(
+        tmp_path, "salt-b",
+        clean=CleanConfig(backend="numpy", max_iter=4, quiet=True,
+                          no_log=True))
+    router = test_fleet._start_router(a, b)
+    try:
+        base = f"http://{router.cfg.host}:{router.port}"
+        first = _post_job(router.port, path)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            router.poll_tick()
+            s1 = json.load(urllib.request.urlopen(
+                f"{base}/jobs/{first['id']}", timeout=30))
+            if s1.get("state") in TERMINAL:
+                break
+            time.sleep(0.05)
+        assert s1["state"] == "done"
+        assert len(router.result_index) == 1
+        dup = _post_job(router.port, path)
+        assert dup.get("served_by") != "fleet-cache"
+        assert router.metrics.counter_total("fleet_cache_hits_total") == 0
+        assert router.metrics.counter_value(
+            "fleet_cache_skips_total",
+            {"reason": "no_unanimous_salt"}) >= 1
+        _wait_done(router.port, [dup["id"]])
+    finally:
+        router.stop()
+        a.stop()
+        b.stop()
+
+
+def test_fleet_top_renders_throughput_columns(tmp_path, capsys):
+    """fleet_top's new columns come off the federated families: the
+    per-bucket coalesce batch-size p50 and cache hit rate, plus the
+    router's own cache line."""
+    import test_fleet
+    import tools.fleet_top as fleet_top
+
+    assert fleet_top.dispatch_size_p50({1: 1.0, 4: 3.0}) == 4.0
+    assert fleet_top.dispatch_size_p50({}) is None
+    assert fleet_top.cache_hit_rate({"hit": 3.0, "miss": 1.0}) == 0.75
+    assert fleet_top.cache_hit_rate({}) is None
+
+    paths = [_write(tmp_path, f"t{i}.npz", seed=20 + i) for i in range(2)]
+    a = test_fleet._start_replica(tmp_path, "top-a", bucket_cap=1,
+                                  coalesce=2, deadline_s=5.0)
+    router = test_fleet._start_router(a)
+    try:
+        base = f"http://{router.cfg.host}:{router.port}"
+        jobs = [_post_job(router.port, p) for p in paths]
+        _wait_done(router.port, [j["id"] for j in jobs])
+        router.poll_tick()   # scrape the replica's counters
+        rc = fleet_top.main(["--router", base, "--json"])
+        assert rc == 0
+        snap = json.loads(capsys.readouterr().out.strip())
+        # The bucket appears with a valid p50 (the registry is
+        # process-global, so earlier tests' k=1 dispatches weigh into
+        # the distribution — the p50 math itself is unit-pinned above).
+        assert snap["coalesce_p50s"].get("4x16x64", 0) >= 1.0
+        assert "4x16x64" in snap["cache_hit_rates"]
+        rc = fleet_top.main(["--router", base])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "CO_P50" in text and "HIT%" in text and "cache=" in text
+    finally:
+        router.stop()
+        a.stop()

@@ -365,7 +365,12 @@ def test_tenant_quota_429_and_wfq_metrics(tmp_path):
         assert router.admission.open_count("t1") == 0
         svc.set_draining(False)
         router.poll_tick()   # the registry must observe the undrain
-        again = _post_job(router, {"path": p},
+        # Fresh bytes, deliberately: re-submitting `p` would hit the
+        # fleet result cache (born terminal, no admission consumed —
+        # tests/test_coalesce.py pins that path) instead of exercising
+        # the freed quota this test is about.
+        p2 = _write(tmp_path, "q2.npz", seed=31)
+        again = _post_job(router, {"path": p2},
                           headers={"X-ICT-Tenant": "t1"})
         assert again["tenant"] == "t1"
         m = router.metrics

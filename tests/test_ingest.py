@@ -307,6 +307,9 @@ class TestPerfGateIngestContract:
         payload["memory"] = {"host_rss_bytes": 1}
         payload["ingest"] = {"overlap_efficiency": 0.8,
                              "codec": {"roundtrip_exact": True}}
+        # The throughput-tier block the contract grew in r07: a bare {}
+        # would (correctly) fail the "no throughput_ratio" check.
+        payload["coalesce"] = {"throughput_ratio": 2.5}
         payload["donation_ledger"] = dict(base["donation_ledger"])
         assert pg.compare(payload, base, 3.0, 1.15) == []
 

@@ -41,9 +41,13 @@ def _get_text(base: str, route: str, timeout_s: float) -> str:
 
 def collect(base: str, timeout_s: float = 10.0) -> dict:
     """The snapshot dict both output modes render: healthz + capacity +
-    alerts, with the straggler/p50 gauges read off the router's own
-    exposition (everything fleet_top shows is an exported figure — the
-    explainability contract, docs/OBSERVABILITY.md)."""
+    alerts, with the straggler/p50 gauges — and the throughput-tier
+    figures (per-bucket coalesce batch-size p50s, result-cache hit
+    rates) — read off the FEDERATED exposition (``GET /fleet/metrics``,
+    whose first section is the router's own registry, so every series
+    the old ``/metrics`` scrape carried is still here).  Everything
+    fleet_top shows is an exported figure — the explainability contract,
+    docs/OBSERVABILITY.md."""
     from iterative_cleaner_tpu.obs import metrics as obs_metrics
 
     health = _get_json(base, "/healthz", timeout_s)
@@ -54,9 +58,14 @@ def collect(base: str, timeout_s: float = 10.0) -> dict:
         alerts = {}   # pre-alerting routers still render everything else
     p50s: dict[str, float] = {}
     scale_events = 0.0
+    # bucket -> {k -> dispatch count} (the merged fleet-wide coalesce
+    # batch-size distribution) and bucket -> {outcome -> count} (the
+    # merged replica-side result-cache counters).
+    co_sizes: dict[str, dict[int, float]] = {}
+    cache_counts: dict[str, dict[str, float]] = {}
     try:
         fams = obs_metrics.parse_exposition(
-            _get_text(base, "/metrics", timeout_s))
+            _get_text(base, "/fleet/metrics", timeout_s))
     except (OSError, ValueError):
         fams = []
     for fam in fams:
@@ -66,6 +75,20 @@ def collect(base: str, timeout_s: float = 10.0) -> dict:
                 p50s[d["replica"]] = obs_metrics.sample_value(raw)
             elif fam.name == "ict_fleet_scale_events_total":
                 scale_events += obs_metrics.sample_value(raw)
+            elif (fam.name == "ict_fleet_coalesce_batch_size_total"
+                    and "shape_bucket" in d and "k" in d):
+                try:
+                    k = int(d["k"])
+                except ValueError:
+                    continue
+                co_sizes.setdefault(d["shape_bucket"], {})[k] = \
+                    co_sizes.get(d["shape_bucket"], {}).get(k, 0.0) \
+                    + obs_metrics.sample_value(raw)
+            elif (fam.name == "ict_fleet_result_cache_total"
+                    and "shape_bucket" in d and "outcome" in d):
+                bucket = cache_counts.setdefault(d["shape_bucket"], {})
+                bucket[d["outcome"]] = (bucket.get(d["outcome"], 0.0)
+                                        + obs_metrics.sample_value(raw))
     return {
         "router": base,
         "router_id": health.get("router_id"),
@@ -74,7 +97,35 @@ def collect(base: str, timeout_s: float = 10.0) -> dict:
         "alerts": alerts,
         "p50s": p50s,
         "scale_events_total": scale_events,
+        "coalesce_p50s": {b: dispatch_size_p50(sizes)
+                          for b, sizes in co_sizes.items()},
+        "cache_hit_rates": {b: cache_hit_rate(counts)
+                            for b, counts in cache_counts.items()},
+        "fleet_cache": health.get("result_cache") or {},
     }
+
+
+def dispatch_size_p50(sizes: dict[int, float]) -> float | None:
+    """Weighted median batch size over one bucket's dispatch counts
+    ({k -> dispatches}) — the per-bucket coalesce figure the bucket
+    table shows."""
+    total = sum(sizes.values())
+    if total <= 0:
+        return None
+    cum = 0.0
+    for k in sorted(sizes):
+        cum += sizes[k]
+        if cum >= total / 2:
+            return float(k)
+    return float(max(sizes))
+
+
+def cache_hit_rate(counts: dict[str, float]) -> float | None:
+    """hits / (hits + misses) for one bucket's merged result-cache
+    counters; None before any lookup."""
+    hits = counts.get("hit", 0.0)
+    total = hits + counts.get("miss", 0.0)
+    return (hits / total) if total > 0 else None
 
 
 def _fmt_num(value) -> str:
@@ -126,23 +177,34 @@ def render(snap: dict) -> str:
             f"{_fmt_num(cap.get('service_rate')):>7} "
             f"{_fmt_num(snap['p50s'].get(rid, cap.get('p50_s'))):>7}")
     buckets = capacity.get("buckets", {})
-    if buckets:
+    co_p50s = snap.get("coalesce_p50s") or {}
+    hit_rates = snap.get("cache_hit_rates") or {}
+    if buckets or co_p50s or hit_rates:
         lines += ["", f"{'BUCKET':<16} {'BACKLOG':>8} {'DEMAND/S':>9} "
-                      f"{'ETA_S':>8} {'COST_B':>10}"]
-        for bucket, rec in sorted(buckets.items()):
+                      f"{'ETA_S':>8} {'COST_B':>10} {'CO_P50':>7} "
+                      f"{'HIT%':>6}"]
+        for bucket in sorted({*buckets, *co_p50s, *hit_rates}):
+            rec = buckets.get(bucket, {})
+            rate = hit_rates.get(bucket)
             lines.append(
                 f"{bucket:<16} {_fmt_num(rec.get('backlog')):>8} "
                 f"{_fmt_num(rec.get('demand_rate')):>9} "
                 f"{_fmt_num(rec.get('eta_s')):>8} "
-                f"{_fmt_num(rec.get('cost_bytes')):>10}")
+                f"{_fmt_num(rec.get('cost_bytes')):>10} "
+                f"{_fmt_num(co_p50s.get(bucket)):>7} "
+                f"{_fmt_num(round(rate * 100, 1)) if rate is not None else '-':>6}")
     fleet = capacity.get("fleet", {})
     if fleet:
+        fc = snap.get("fleet_cache") or {}
         lines += ["",
                   f"fleet  util={_fmt_num(fleet.get('utilization'))}  "
                   f"rate={_fmt_num(fleet.get('service_rate'))}/s  "
                   f"demand={_fmt_num(fleet.get('demand_rate'))}/s  "
                   f"backlog={_fmt_num(fleet.get('backlog'))}  "
-                  f"eta={_fmt_num(fleet.get('backlog_eta_s'))}s"]
+                  f"eta={_fmt_num(fleet.get('backlog_eta_s'))}s  "
+                  f"cache={_fmt_num(fc.get('hits'))}h/"
+                  f"{_fmt_num(fc.get('misses'))}m"
+                  f" ({_fmt_num(fc.get('entries'))} idx)"]
     scaler = capacity.get("autoscale")
     if scaler:
         last = scaler.get("last_decision") or {}

@@ -5,7 +5,10 @@ and demands bit-identical final masks between the numpy oracle and every JAX
 execution mode — stepwise, fused, chunked (random block, both the pipelined
 ingest default and the ICT_INGEST_DEPTH=1 serial path), the Pallas stats
 megakernel (forced on; interpret mode here, the same kernel body the TPU
-auto-default compiles), the 8-device sharded path, and the streaming-ingest
+auto-default compiles), the 8-device sharded path, the coalesced batch
+(K=3 mixed-seed same-shape cubes through one vmapped dispatch — the
+service scheduler's coalescing rung at the parallel layer; a mismatch on
+ANY batch member fails the mode), and the streaming-ingest
 online route (random block splits, canonical finalize) — plus loop-count
 agreement.  ICT_MEDIAN_SELECT=topk re-runs the whole sweep on the selection
 lowering of the robust-scaler medians (the TPU default; sort elsewhere).
@@ -141,6 +144,44 @@ def main() -> int:
                 D, w0, CleanConfig(backend="jax", **kw), mesh)
             modes["sharded"] = (w_sh, loops_sh, done_sh)
             mode_cfgs["sharded"] = CleanConfig(backend="jax", **kw)
+
+            # The coalesced mode (ROADMAP item 2's throughput rung): K=3
+            # MIXED-seed same-shape cubes stacked through one
+            # batched_fused_clean dispatch — the scheduler's coalescing
+            # path at the parallel layer — and each archive's mask must
+            # be bit-identical to ITS OWN numpy oracle (the vmapped loop
+            # runs until the whole batch converges, so per-archive
+            # results must not bleed across the batch axis).
+            from iterative_cleaner_tpu.io.synthetic import make_archive
+            from iterative_cleaner_tpu.parallel.sharded import sharded_clean
+
+            extras = []
+            for j in (1, 2):
+                arch_j = make_archive(nsub=D.shape[0], nchan=D.shape[1],
+                                      nbin=D.shape[2],
+                                      seed=seed * 7 + j)
+                Dj, w0j = preprocess(arch_j)
+                res_j = clean_cube(Dj, w0j,
+                                   CleanConfig(backend="numpy", **kw))
+                extras.append((Dj, w0j, res_j))
+            Db = np.stack([D] + [e[0] for e in extras])
+            w0b = np.stack([w0] + [e[1] for e in extras])
+            cfg_co = CleanConfig(backend="jax", **kw)
+            _tb, w_b, loops_b, done_b = sharded_clean(Db, w0b, cfg_co,
+                                                      mesh)
+            oracles = [res_np] + [e[2] for e in extras]
+            co_ok = all(
+                np.array_equal(w_b[j], oracles[j].weights)
+                and int(loops_b[j]) == oracles[j].loops
+                and bool(done_b[j]) == oracles[j].converged
+                for j in range(len(oracles)))
+            # Reported through the same bad-mode machinery: compare the
+            # lead archive's slice (the shared-seed cube) so the repro
+            # bundle carries reproducible inputs.
+            modes["coalesced(k=3)"] = (
+                w_b[0] if co_ok else np.full_like(w_b[0], -1.0),
+                int(loops_b[0]), bool(done_b[0]))
+            mode_cfgs["coalesced(k=3)"] = cfg_co
 
         bad = [name for name, (w, loops, conv) in modes.items()
                if not (np.array_equal(w, res_np.weights)

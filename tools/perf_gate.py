@@ -38,6 +38,14 @@ What is gated, and why it is non-flaky on shared CI runners:
   fixed ``SHARE_CEILING`` (the OVERLAP_COLLAPSE pattern: the threshold
   sits far above measured load noise and below the sort-bound failure
   mode);
+- **coalesce contract** (the throughput tier): the ``coalesce`` block
+  must exist with a measured ``throughput_ratio`` (one K-batch dispatch
+  vs K solo dispatches, warm, intra-run so machine speed cancels), its
+  parity flags (batch-vs-solo, batch-vs-oracle, cache-hit byte
+  identity) must be true, and the ratio must not collapse below
+  ``COALESCE_COLLAPSE`` whenever the baseline demonstrated the
+  ``COALESCE_FLOOR`` (= 2x) acceptance bar — a lost batch lowering
+  reads ~1.0, load noise cannot take an 8-way amortization there;
 - **ingest contract**: the ``ingest`` block must exist with an
   ``overlap_efficiency`` figure, the wire codec's round-trip must be
   bit-exact, the upload/compute overlap must not COLLAPSE (below 0.25 —
@@ -129,9 +137,12 @@ STATIC_KEYS = ("step_dense_bytes_cubes", "step_incremental_bytes_cubes",
 
 #: Blocks bench.py promises on every exit path since the obs layer landed
 #: ("ingest" since the ingest tier: upload-pipeline + wire-codec
-#: accounting, with overlap_efficiency hoisted to its top level).
+#: accounting, with overlap_efficiency hoisted to its top level;
+#: "coalesce" since the throughput tier: K-batch vs K-solo warm
+#: throughput + content-cache round-trip, parity-flagged).
 REQUIRED_KEYS = ("metric", "value", "unit", "vs_baseline",
-                 "compile_accounting", "memory", "audit", "ingest")
+                 "compile_accounting", "memory", "audit", "ingest",
+                 "coalesce")
 
 #: The tentpole's acceptance bar: the baseline must have demonstrated
 #: >= 50% upload/compute overlap for the floor check to arm at all.
@@ -161,6 +172,18 @@ SHARE_CEILING = 0.68
 #: the failure mode.  Gating at OVERLAP_FLOOR itself would violate the
 #: module's non-flaky-on-shared-runners contract.
 OVERLAP_COLLAPSE = 0.25
+
+#: Coalescing-throughput ratchet (the throughput tier's acceptance bar,
+#: the OVERLAP_COLLAPSE pattern): the baseline must have demonstrated a
+#: >= 2x warm jobs/s advantage of one K-batch dispatch over K solo
+#: dispatches for the check to arm...
+COALESCE_FLOOR = 2.0
+#: ...and once armed it fails only on a COLLAPSE below this: losing the
+#: batch lowering entirely (K sequential dispatches in a batch-shaped
+#: wrapper) reads ~1.0, while runner load alone cannot drag an 8-way
+#: launch amortization under 1.3 (the ratio is intra-run; machine speed
+#: cancels).
+COALESCE_COLLAPSE = 1.3
 
 
 def run_gate_bench() -> dict:
@@ -254,6 +277,34 @@ def compare(payload: dict, baseline: dict, ratio_tolerance: float,
                 f"{base_ing['overlap_efficiency']:.3g}, collapse threshold "
                 f"{OVERLAP_COLLAPSE:g}) — the upload pipeline stopped "
                 f"hiding transfers under compute (a lost stager reads 0)")
+
+    # Throughput-tier contract: the coalesce block must carry the
+    # K-batch-vs-solo throughput ratio (the parity flags inside it —
+    # batch vs solo vs oracle, cache-hit byte identity — are covered by
+    # the parity walk above), and the ratio must not collapse whenever
+    # the baseline demonstrated the >= 2x acceptance floor.
+    co = payload.get("coalesce")
+    if isinstance(co, dict):
+        if co.get("error"):
+            problems.append(
+                f"coalesce section errored: {co['error']!r} — the "
+                "throughput-tier arm did not measure")
+        elif not isinstance(co.get("throughput_ratio"), (int, float)):
+            problems.append("coalesce block has no throughput_ratio")
+        base_co = baseline.get("coalesce")
+        if (isinstance(base_co, dict)
+                and isinstance(base_co.get("throughput_ratio"),
+                               (int, float))
+                and base_co["throughput_ratio"] >= COALESCE_FLOOR
+                and isinstance(co.get("throughput_ratio"), (int, float))
+                and co["throughput_ratio"] < COALESCE_COLLAPSE):
+            problems.append(
+                f"coalesce.throughput_ratio collapsed to "
+                f"{co['throughput_ratio']:.3g} (baseline "
+                f"{base_co['throughput_ratio']:.3g}, collapse threshold "
+                f"{COALESCE_COLLAPSE:g}) — one K-batch dispatch no "
+                f"longer beats K solo dispatches (a lost batch lowering "
+                f"reads ~1.0)")
 
     # Donation ledger: ZERO tolerance.  A drifted ledger means a donation
     # vanished (silent perf regression) or appeared unregistered
@@ -355,6 +406,8 @@ def history_line(payload: dict, ok: bool) -> dict:
         "unfused_step_s": (payload.get("phases") or {}).get("unfused_step_s"),
         "ingest_overlap_efficiency": ing.get("overlap_efficiency"),
         "ingest_codec_ratio": ing.get("codec_ratio"),
+        "coalesce_throughput_ratio": (payload.get("coalesce") or {}
+                                      ).get("throughput_ratio"),
         "ts": round(time.time(), 3),
         "ok": ok,
         "device": payload.get("device"),
