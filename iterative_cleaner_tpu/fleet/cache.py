@@ -37,7 +37,7 @@ DEFAULT_CAPACITY = 4096
 #: state/served_by/replica_id are rewritten at serve time.
 _KEEP_FIELDS = ("out_path", "loops", "converged", "rfi_frac",
                 "termination", "shape", "quality", "content_key",
-                "file_digest")
+                "file_digest", "cost")
 
 
 class FleetResultIndex:

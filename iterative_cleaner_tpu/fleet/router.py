@@ -54,6 +54,7 @@ from iterative_cleaner_tpu.fleet import alerts as fleet_alerts
 from iterative_cleaner_tpu.fleet import autoscale as fleet_autoscale
 from iterative_cleaner_tpu.fleet import cache as fleet_cache
 from iterative_cleaner_tpu.fleet import capacity as fleet_capacity
+from iterative_cleaner_tpu.fleet import costs as fleet_costs
 from iterative_cleaner_tpu.fleet import history as fleet_history
 from iterative_cleaner_tpu.fleet import obs as fleet_obs
 from iterative_cleaner_tpu.fleet.client import (
@@ -131,6 +132,12 @@ class FleetConfig:
     placement_keep: int = 10000      # terminal placement records kept
     tenant_quotas: dict = field(default_factory=dict)
     tenant_weights: dict = field(default_factory=dict)
+    tenant_budgets: dict = field(default_factory=dict)
+                                     # advisory device-seconds budgets
+                                     # (--tenant NAME:QUOTA:WEIGHT:BUDGET)
+                                     # feeding tenant_budget_burn alert
+                                     # rules — showback, never admission
+                                     # (fleet/costs.py)
     default_quota: int = 0           # per-tenant open-placement cap (0 = off)
     default_weight: float = 1.0
     telemetry: str = ""              # JSON-lines event log (obs/events)
@@ -370,6 +377,11 @@ class FleetRouter:
                 poll_interval_s=cfg.poll_interval_s,
                 scale_up_eta_s=cfg.scale_up_eta_s,
                 autoscale=cfg.autoscale))
+        # Tenant budgets install their advisory burn rules next to the
+        # default pack (fleet/costs.py; present regardless of
+        # --no_default_alerts — a declared budget nobody watches would
+        # be a lie); operator --alert_rule names still override.
+        rules.extend(fleet_costs.budget_rules(cfg.tenant_budgets))
         for spec in cfg.alert_rules:
             rule = (spec if isinstance(spec, fleet_alerts.AlertRule)
                     else fleet_alerts.parse_rule(spec))
@@ -423,6 +435,17 @@ class FleetRouter:
         # touching any replica.  Owns its own lock, acquired strictly
         # after the router's, never while calling out.
         self.result_index = fleet_cache.FleetResultIndex()
+        # The cost-accounting fold (fleet/costs.py): rebuilt once per
+        # poll tick from the scrape cache, served at GET /fleet/costs.
+        self._costs_snapshot: dict = {}  # ict: guarded-by(self._lock)
+        # Pre-register the budget gauge at 0 for every budgeted tenant
+        # (the daemon's ict_cost_* pre-registration lesson, router
+        # side): the burn rules are gt thresholds, and the series must
+        # exist before the first placement for firing AND resolution to
+        # work from the first tick.
+        self.metrics.replace_gauge_family(
+            "fleet_tenant_budget_used_pct",
+            {(("tenant", t),): 0.0 for t in cfg.tenant_budgets})
         # Last observed (audit_divergences, backend) per replica: the
         # incident watch fires a bundle when divergences move or a
         # replica demotes jax -> numpy between polls.
@@ -545,6 +568,7 @@ class FleetRouter:
         self._failover_sweep()
         self._update_replica_gauges()
         self._update_capacity()
+        self._update_costs()
         self._autoscale_tick()
         self._history_alert_tick()
         self._trim_placements()
@@ -836,6 +860,21 @@ class FleetRouter:
         self.capacity.update(self.registry.snapshot(),
                              self.scrapes.snapshot())
         for family, entries in self.capacity.gauge_families().items():
+            self.metrics.replace_gauge_family(family, entries)
+
+    def _update_costs(self) -> None:
+        """Fold this tick's scrape cache into the fleet cost view
+        (fleet/costs.py) and republish the budget-usage and
+        conservation-ratio gauge families whole — the same
+        snapshot-then-replace discipline as the capacity model, zero new
+        scrape traffic."""
+        snap = fleet_costs.fold(self.registry.snapshot(),
+                                self.scrapes.snapshot(),
+                                self.cfg.tenant_budgets)
+        with self._lock:
+            self._costs_snapshot = snap
+        for family, entries in fleet_costs.gauge_families(
+                snap, self.cfg.tenant_budgets).items():
             self.metrics.replace_gauge_family(family, entries)
 
     def _autoscale_tick(self) -> None:
@@ -1232,6 +1271,18 @@ class FleetRouter:
         job_id = f"{int(time.time() * 1000):013d}-fc{uuid.uuid4().hex[:6]}"
         manifest = {**entry, "path": str(payload.get("path", "") or ""),
                     "served_by": "fleet-cache", "origin": origin}
+        # The served manifest's cost record is the HIT's (zero device
+        # time, the origin's figures as avoided cost) — not the origin's
+        # own record, which stays under its own job id.
+        origin_cost = entry.get("cost") or {}
+        manifest["cost"] = {
+            "tenant": tenant, "route": "fleet-cache", "cache_hit": True,
+            "device_s": 0.0, "compile_s": 0.0,
+            "avoided_device_s": float(origin_cost.get("device_s", 0.0)
+                                      or 0.0),
+            "avoided_bytes_accessed": float(
+                origin_cost.get("bytes_accessed", 0.0) or 0.0),
+        }
         placement = Placement(
             job_id=job_id, tenant=tenant, trace_id=trace_id,
             payload=payload, base_url="",
@@ -1252,10 +1303,26 @@ class FleetRouter:
                 nbytes *= float(dim)
             self.metrics.count("fleet_cache_bytes_saved_total",
                                inc=nbytes)
+        # Avoided cost, attributed to the SUBMITTING tenant with the
+        # origin job's recorded figures (obs/costs.py's cache-hit rule,
+        # router tier): the manifest the index learned carries the
+        # origin's CostRecord.
+        self.metrics.count("fleet_cost_cache_avoided_seconds_total",
+                           {"tenant": tenant},
+                           inc=float(origin_cost.get("device_s", 0.0)
+                                     or 0.0))
+        # Born-terminal placements get a COMPLETE trace (submit →
+        # fleet_cache_hit → done): there is no replica hop to walk, so
+        # the stitcher serves these router spans alone — never an
+        # "unavailable" hop probe at the long-gone origin replica.
+        self.traces.record(trace_id, "fleet_submit", job_id=job_id,
+                           tenant=tenant)
         self.traces.record(trace_id, "fleet_cache_hit", job_id=job_id,
                            origin_job_id=origin.get("job_id", ""),
                            replica_id=origin.get("replica_id", ""),
                            tenant=tenant)
+        self.traces.record(trace_id, "fleet_done", job_id=job_id,
+                           served_by="fleet-cache")
         if events.active():
             events.emit("fleet_cache_hit", trace_id=trace_id,
                         job_id=job_id,
@@ -1508,6 +1575,15 @@ class FleetRouter:
         if rep is not None and rep.alive:
             try:
                 manifest = self.client.job(p.base_url, p.replica_job_id)
+                # Re-record the (idempotent, newest-wins) cache entry:
+                # the FIRST done observation can precede the replica's
+                # CostRecord finalization (it rides the post-dispatch
+                # telemetry pass, seconds late on a bucket's first
+                # dispatch), so a later read refreshes the learned
+                # entry with the finalized avoided-cost figures.
+                if manifest.get("state") == "done":
+                    self.result_index.record(manifest,
+                                             origin_replica=p.replica_id)
                 return 200, {**manifest, "id": p.job_id,
                              "replica_id": p.replica_id, "tenant": p.tenant}
             except ReplicaRefused:
@@ -1612,6 +1688,18 @@ class FleetRouter:
             "sinks": {"webhook": bool(self.cfg.alert_webhook),
                       "cmd": bool(self.cfg.alert_cmd)},
         })
+
+    def fleet_costs(self) -> dict:
+        """``GET /fleet/costs``: the cost-accounting fold — per-tenant
+        showback rows (device-seconds, jobs, compile-seconds, cache
+        savings, budget usage), per-bucket device time + attainment,
+        per-route split, and per-replica conservation ratios — strict
+        JSON, the ``/fleet/capacity`` IEEE-specials discipline."""
+        with self._lock:
+            snap = dict(self._costs_snapshot)
+        return _json_safe({**snap, "router_id": self.router_id,
+                           "conservation_tolerance":
+                               fleet_costs.CONSERVATION_TOLERANCE})
 
     def fleet_metrics_history(self, ticks: int | None = None) -> dict:
         """``GET /fleet/metrics/history``: the bounded ring of per-tick
@@ -1844,6 +1932,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._reply(200, router.fleet_alerts())
         elif self.path == "/fleet/capacity":
             self._reply(200, router.fleet_capacity())
+        elif self.path == "/fleet/costs":
+            self._reply(200, router.fleet_costs())
         elif self.path.startswith("/fleet/trace/"):
             tid = self.path[len("/fleet/trace/"):]
             code, payload = router.fleet_trace(tid)
@@ -1904,6 +1994,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
             return
         tenant = str(self.headers.get("X-ICT-Tenant", "")
                      or DEFAULT_TENANT)
+        # The tenant crosses the hop inside the payload (and therefore
+        # rides failover re-routes verbatim): the replica stamps it on
+        # the job so the cost ledger's showback attribution and the
+        # router's admission accounting can never disagree about who a
+        # job belongs to (obs/costs.py).
+        payload["tenant"] = tenant
         trace_id = str(self.headers.get("X-ICT-Trace", "")
                        or events.new_trace_id())
         try:
@@ -1963,10 +2059,14 @@ def build_fleet_parser() -> argparse.ArgumentParser:
                    help="full-jitter backoff base between sweeps "
                         "(default 0.25)")
     p.add_argument("--tenant", action="append", default=[],
-                   metavar="NAME:QUOTA:WEIGHT",
+                   metavar="NAME:QUOTA:WEIGHT[:BUDGET]",
                    help="per-tenant admission spec (repeatable): QUOTA open "
-                        "placements (0 = unbounded) and WFQ WEIGHT, e.g. "
-                        "--tenant survey:64:3 --tenant adhoc:8:1")
+                        "placements (0 = unbounded), WFQ WEIGHT, and an "
+                        "optional ADVISORY device-seconds BUDGET feeding "
+                        "the tenant_budget_burn alert rules (warning at "
+                        "80%%, critical at 100%% — rules, never admission "
+                        "changes), e.g. --tenant survey:64:3:3600 "
+                        "--tenant adhoc:8:1")
     p.add_argument("--default_quota", type=int, default=0, metavar="N",
                    help="open-placement quota for undeclared tenants "
                         "(0 = unbounded; default 0)")
@@ -2089,23 +2189,46 @@ def build_fleet_parser() -> argparse.ArgumentParser:
     return p
 
 
-def parse_tenant_specs(specs: list[str]) -> tuple[dict, dict]:
+def parse_tenant_specs(specs: list[str]) -> tuple[dict, dict, dict]:
+    """``NAME:QUOTA:WEIGHT[:BUDGET]`` -> (quotas, weights, budgets).
+    BUDGET is an optional ADVISORY device-seconds budget (> 0) feeding
+    the tenant_budget_burn alert rules (fleet/costs.py) — it never
+    changes admission; quotas stay the only admission lever."""
     quotas: dict[str, int] = {}
     weights: dict[str, float] = {}
+    budgets: dict[str, float] = {}
     for spec in specs:
         try:
-            name, quota, weight = spec.split(":")
+            parts = spec.split(":")
+            if len(parts) == 3:
+                name, quota, weight = parts
+                budget = ""
+            elif len(parts) == 4:
+                name, quota, weight, budget = parts
+            else:
+                raise ValueError
             if not name:
                 raise ValueError
             quotas[name] = int(quota)
             weights[name] = float(weight)
             if quotas[name] < 0 or weights[name] <= 0:
                 raise ValueError
+            if len(parts) == 4:
+                # An EMPTY fourth field ('survey:64:3:' — a trailing
+                # colon typo, or an empty $BUDGET shell variable) is the
+                # malformation that looks most like an intended budget:
+                # reject it loudly instead of silently unmetering the
+                # tenant.
+                budgets[name] = float(budget)
+                if budgets[name] <= 0:
+                    raise ValueError
         except ValueError:
             raise ValueError(
-                f"bad --tenant spec {spec!r}; expected NAME:QUOTA:WEIGHT "
-                "like survey:64:3 (quota >= 0, weight > 0)") from None
-    return quotas, weights
+                f"bad --tenant spec {spec!r}; expected "
+                "NAME:QUOTA:WEIGHT[:BUDGET] like survey:64:3 or "
+                "survey:64:3:3600 (quota >= 0, weight > 0, optional "
+                "advisory device-seconds budget > 0)") from None
+    return quotas, weights, budgets
 
 
 def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
@@ -2170,7 +2293,7 @@ def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
         for spec in file_rules:
             fleet_alerts.parse_rule(spec)
             alert_rules.append(spec)
-    quotas, weights = parse_tenant_specs(args.tenant)
+    quotas, weights, budgets = parse_tenant_specs(args.tenant)
     return FleetConfig(
         replicas=tuple(args.replica),
         host=args.host,
@@ -2184,6 +2307,7 @@ def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
         retry_backoff_s=args.retry_backoff_s,
         tenant_quotas=quotas,
         tenant_weights=weights,
+        tenant_budgets=budgets,
         default_quota=args.default_quota,
         default_weight=args.default_weight,
         telemetry=args.telemetry,
@@ -2334,6 +2458,11 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                 "family": "ict_fleet_open_placements",
                 "predicate": {"op": "gt", "value": 0}, "for_ticks": 1,
                 "description": "serve-fleet --smoke injected rule"},),
+            # The costs lane (ISSUE 15): a deliberately tiny advisory
+            # budget that ONE dispatch's device-seconds must blow
+            # through, driving a full tenant_budget_burn firing ->
+            # resolved cycle through the alert plane below.
+            "tenant_budgets": {**cfg.tenant_budgets, "smokecost": 1e-4},
         }))
         router.start()
         jobs = {}
@@ -2474,11 +2603,12 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
             # Two fresh same-shape cubes submitted back to back must
             # share ONE coalesced dispatch on replica b (bucket_cap 1 x
             # coalesce 2), each mask bit-identical to its own oracle.
-            def submit(p, extra=None):
+            def submit(p, extra=None, headers=None):
                 req = urllib.request.Request(
                     f"{base}/jobs",
                     data=json.dumps({"path": p, **(extra or {})}).encode(),
-                    headers={"Content-Type": "application/json"})
+                    headers={"Content-Type": "application/json",
+                             **(headers or {})})
                 return json.load(urllib.request.urlopen(req, timeout=30))
 
             co_paths = []
@@ -2542,10 +2672,98 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
             cache_ok = (dup.get("served_by") == "fleet-cache"
                         and fleet_cache_hits >= 1 and dup_no_work
                         and dup_masks_ok)
+            # --- the cost-accounting plane (ISSUE 15), end to end ---
+            # A tenant-tagged job burns through the injected tiny
+            # budget; the costs lane then asserts (a) attribution
+            # CONSERVES — summed per-job device-seconds equal the
+            # dispatch-seconds counter within 1%, (b) /fleet/costs
+            # carries per-tenant rows, and (c) the tenant_budget_burn
+            # rule completes a firing -> resolved cycle (resolution via
+            # the replica leaving the fleet — the advisory-budget
+            # semantics fleet/costs.py documents).
+            cost_path = os.path.join(tmp, "smokecost.npz")
+            NpzIO().save(make_archive(nsub=4, nchan=16, nbin=64,
+                                      seed=700), cost_path)
+            cost_job = submit(cost_path, {"shape": [4, 16, 64]},
+                              headers={"X-ICT-Tenant": "smokecost"})
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                state = json.load(urllib.request.urlopen(
+                    f"{base}/jobs/{cost_job['id']}", timeout=10))
+                if state.get("state") in TERMINAL:
+                    break
+                time.sleep(0.05)
+            # Conservation off the replica exposition (both in-process
+            # replicas share one registry; the sums on both sides cover
+            # both, so the identity still holds exactly).  Bounded
+            # retry: a job turns terminal (HTTP-visible) a beat before
+            # the worker finalizes its cost record, so one read could
+            # catch the window; a PERSISTENT violation still fails.
+            cost_sum = dispatch_sum = 0.0
+            conservation_ok = False
+            deadline = time.time() + 60
+            while time.time() < deadline and not conservation_ok:
+                cost_text = urllib.request.urlopen(
+                    f"http://127.0.0.1:{svc_b.port}/metrics",
+                    timeout=10).read().decode()
+                cost_sum = dispatch_sum = 0.0
+                try:
+                    for fam in obs_metrics.parse_exposition(cost_text):
+                        for name, _labels, raw in fam.samples:
+                            if name == "ict_cost_device_seconds_total":
+                                cost_sum += obs_metrics.sample_value(raw)
+                            elif name == "ict_service_dispatch_s":
+                                dispatch_sum += obs_metrics.sample_value(raw)
+                except ValueError:
+                    break
+                conservation_ok = (dispatch_sum > 0 and abs(
+                    cost_sum / dispatch_sum - 1.0)
+                    <= fleet_costs.CONSERVATION_TOLERANCE)
+                if not conservation_ok:
+                    time.sleep(0.1)
+            router.poll_tick()   # fold the scrape into /fleet/costs
+            costs_view = json.load(urllib.request.urlopen(
+                f"{base}/fleet/costs", timeout=10))
+            tenant_rows = costs_view.get("tenants", {})
+            tenant_rows_ok = (
+                "smokecost" in tenant_rows
+                and tenant_rows["smokecost"].get("device_s", 0) > 0
+                and (tenant_rows["smokecost"].get("budget_used_pct") or 0)
+                > 100
+                and "default" in tenant_rows)
+            burn_rule = "tenant_budget_burn:smokecost"
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if any(a["rule"] == burn_rule
+                       for a in router.alerts.firing()):
+                    break
+                router.poll_tick()
+                time.sleep(0.05)
+            budget_fired = any(a["rule"] == burn_rule
+                               for a in router.alerts.firing())
+            # Resolution: stop replica b — once the registry marks it
+            # dead, its per-life usage leaves the budget gauge (rebuilt
+            # whole from ALIVE replicas) and the rule must resolve.
+            svc_b.stop()
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                router.poll_tick()
+                if not any(a["rule"] == burn_rule
+                           for a in router.alerts.firing()):
+                    break
+                time.sleep(0.05)
+            burn_cycle = [t["state"] for t in router.alerts.recent()
+                          if t["rule"] == burn_rule]
+            budget_cycle_ok = (budget_fired
+                               and burn_cycle[:1] == ["firing"]
+                               and "resolved" in burn_cycle)
+            costs_ok = (state.get("state") == "done" and conservation_ok
+                        and tenant_rows_ok and budget_cycle_ok)
             ok = (all_done and masks_ok and failovers >= 1
                   and done_delta == len(paths)
                   and fleet_ok and trace_ok and len(incidents) >= 1
                   and alerts_ok and coalesce_ok and cache_ok
+                  and costs_ok
                   and health_b.get("audits_run", 0) >= 1
                   and health_b.get("audit_divergences", 0) == 0)
             result = {
@@ -2567,6 +2785,12 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                 "coalesce_masks_ok": bool(co_masks_ok),
                 "fleet_cache_hits": int(fleet_cache_hits),
                 "fleet_cache_hit_ok": bool(cache_ok),
+                "costs_lane_ok": bool(costs_ok),
+                "cost_conservation_ratio": (
+                    round(cost_sum / dispatch_sum, 4)
+                    if dispatch_sum > 0 else None),
+                "cost_tenant_rows_ok": bool(tenant_rows_ok),
+                "budget_burn_cycle_ok": bool(budget_cycle_ok),
                 "audits_run": health_b.get("audits_run", 0),
                 "audit_divergences": health_b.get("audit_divergences", 0),
                 "placements": {

@@ -157,6 +157,11 @@ class _Handler(BaseHTTPRequestHandler):
                              obs_metrics.CONTENT_TYPE)
         elif self.path == "/metrics.json":
             self._reply(200, tracing.counters_snapshot())
+        elif self.path == "/costs":
+            # The replica's lifetime showback ledger (obs/costs.py):
+            # spool-persisted, restart-resumed — the durable record next
+            # to the per-process-life ict_cost_* counters on /metrics.
+            self._reply(200, service.ctx.cost_ledger.report())
         elif self.path.startswith("/jobs/"):
             jid, sep, verb = self.path[len("/jobs/"):].partition("/")
             job = service.job(jid)
@@ -299,6 +304,7 @@ class _Handler(BaseHTTPRequestHandler):
             profile = bool(body.get("profile", False))
             audit = bool(body.get("audit", False))
             idem_key = str(body.get("idempotency_key", "") or "")
+            tenant = str(body.get("tenant", "") or "")
         # TypeError covers valid-JSON non-dict bodies ('[]', '5', 'null'):
         # the client gets a 400, not a dropped socket.
         except (ValueError, KeyError, TypeError) as exc:
@@ -310,12 +316,16 @@ class _Handler(BaseHTTPRequestHandler):
         # A submission that already crossed the fleet router carries its
         # trace context in the X-ICT-Trace header; adopt it instead of
         # minting so the event log threads router placement -> replica
-        # dispatch under ONE trace_id.
+        # dispatch under ONE trace_id.  The tenant rides the same way
+        # (the router forwards its admission tenant in the body; direct
+        # submitters may send the X-ICT-Tenant header) — it is the cost
+        # ledger's showback key (obs/costs.py).
         trace_id = str(self.headers.get("X-ICT-Trace", "") or "")
+        tenant = tenant or str(self.headers.get("X-ICT-Tenant", "") or "")
         try:
             job = service.submit(str(path), profile=profile, audit=audit,
                                  idempotency_key=idem_key,
-                                 trace_id=trace_id)
+                                 trace_id=trace_id, tenant=tenant)
         except ServiceBusy as exc:
             self._reply(503, {"error": str(exc)}, headers={"Retry-After": "5"})
             return

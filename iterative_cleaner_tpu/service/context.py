@@ -111,6 +111,15 @@ class ReplicaContext:
         # stamped on every manifest: the fleet router's cache only
         # answers a submission when every candidate replica agrees on it.
         self.cache_salt = cas.cache_salt(self.clean_cfg)
+        # The cost-accounting ledger (obs/costs.py): per-replica by
+        # construction (fleet tests run several replicas per process),
+        # spool-persisted next to the job index so a restart resumes the
+        # lifetime showback record.
+        from iterative_cleaner_tpu.obs.costs import CostLedger
+
+        self.cost_ledger = CostLedger(
+            os.path.join(serve_cfg.spool_dir, "costs.json"),
+            replica_id=self.replica_id)
         # The shadow auditor; assigned once by the daemon during start(),
         # before any worker thread runs.
         self.auditor = None
@@ -305,7 +314,8 @@ class ReplicaContext:
         return obs_audit.audit_rate()
 
     def new_job(self, path: str, profile: bool = False, audit: bool = False,
-                idempotency_key: str = "", trace_id: str = "") -> Job:
+                idempotency_key: str = "", trace_id: str = "",
+                tenant: str = "") -> Job:
         """Mint one job record.  The trace context is minted HERE unless
         the submitter carried one across the router hop (X-ICT-Trace) —
         either way it rides the job through every layer and is echoed in
@@ -316,4 +326,4 @@ class ReplicaContext:
         return Job(id=new_job_id(), path=path, submitted_s=time.time(),
                    trace_id=trace_id or events.new_trace_id(),
                    profile=bool(profile), audit=bool(audit),
-                   idem_key=idempotency_key)
+                   idem_key=idempotency_key, tenant=tenant)

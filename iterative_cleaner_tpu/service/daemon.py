@@ -303,6 +303,13 @@ class CleaningService:
         # it against the restarted replica's explicit 0.
         tracing.count("audit_divergences", 0)
         tracing.count("service_backend_demotions", 0)
+        # Same lesson for the cost-accounting plane (ISSUE 15): every
+        # ict_cost_* family is registered at 0 before the first scrape,
+        # so the fleet's tenant-budget gt-thresholds can resolve against
+        # a restarted replica's explicit 0 instead of freezing on a
+        # missing series.  The ledger itself resumes its spool-persisted
+        # lifetime aggregates separately (GET /costs).
+        self.ctx.cost_ledger.register_counters()
         self.ctx.auditor = ShadowAuditor(
             self.spool, self.repro_dir,
             on_divergence=self.ctx.note_audit_divergence,
@@ -355,6 +362,11 @@ class CleaningService:
             th.join(timeout=10)
             if th.is_alive():
                 stuck.append(th.name)
+        # The showback record survives the shutdown (restart-resume is
+        # the ledger's contract too): flushed AFTER the worker joins, so
+        # the last served jobs' records make it to disk; a no-op when
+        # nothing is dirty.
+        self.ctx.cost_ledger.flush()
         if stuck:
             # A live thread may still be WRITING spool manifests; releasing
             # the flock would let a successor daemon's .part sweep and
@@ -370,7 +382,7 @@ class CleaningService:
 
     def submit(self, path: str, profile: bool = False,
                audit: bool = False, idempotency_key: str = "",
-               trace_id: str = "") -> Job:
+               trace_id: str = "", tenant: str = "") -> Job:
         # A draining replica accepts no NEW work (503; the router reads the
         # same flag off /healthz and stops placing here) — already-accepted
         # jobs keep running to completion (docs/SERVING.md "Fleet").
@@ -399,7 +411,7 @@ class CleaningService:
         # (obs/audit; ICT_AUDIT_RATE / --audit_rate samples the rest).
         job = self.ctx.new_job(path, profile=profile, audit=audit,
                                idempotency_key=idempotency_key,
-                               trace_id=trace_id)
+                               trace_id=trace_id, tenant=tenant)
         dup_id = self.ctx.admit(job, idempotency_key)
         if dup_id is not None:
             # Lost an admission race on the same key: serve the winner.
@@ -601,6 +613,10 @@ class CleaningService:
                 # federated scrape (docs/OBSERVABILITY.md "Alerting &
                 # history").
                 obs_memory.update_spool_gauge(self.serve_cfg.spool_dir)
+                # The cost ledger's dirty aggregates ride it too — a
+                # bounded-staleness persist instead of one atomic write
+                # per served job (obs/costs.py; flush never raises).
+                self.ctx.cost_ledger.flush()
 
     def _on_flush(self, entries) -> None:
         tracing.count("service_buckets_dispatched")

@@ -75,6 +75,16 @@ class Job:
                                     # a re-submission carrying the same key
                                     # dedupes against this job instead of
                                     # running it twice (service/context.py)
+    tenant: str = ""                # showback identity (X-ICT-Tenant /
+                                    # the router's forwarded "tenant"
+                                    # field; "" reads as "default") — the
+                                    # cost ledger's aggregation key
+                                    # (obs/costs.py)
+    # Cost accounting (obs/costs.py): device-seconds split by phase,
+    # compile seconds, apportioned static bytes/FLOPs, coalesced batch
+    # size, cache-hit avoided cost, attainment — stamped by the dispatch
+    # worker, persisted on the manifest (ISSUE 15's showback record).
+    cost: dict = field(default_factory=dict)
     # Shadow-audit outcome, re-persisted once the background replay
     # finishes: mask_identical, n_mask_diffs, score drift vs the
     # documented bound, and the repro-bundle path on a divergence.

@@ -35,6 +35,7 @@ import numpy as np
 
 from iterative_cleaner_tpu.obs import (
     audit as obs_audit,
+    costs as obs_costs,
     events,
     flight,
     forensics,
@@ -154,6 +155,17 @@ class DispatchWorker(threading.Thread):
                             job_id=e.job.id, bucket_size=1,
                             backend="cache",
                             origin_job_id=rec.get("origin_job_id", ""))
+            # Cost accounting (obs/costs): a hit consumes no device time;
+            # the avoided cost is the ORIGIN job's recorded figures (its
+            # manifest outlives retire() in the spool; a pruned origin
+            # just reads as zero avoided cost, never a guess).  The one
+            # manifest read is noise next to the archive decode this hit
+            # already paid in the loader.
+            origin_id = str(rec.get("origin_job_id", "") or "")
+            origin = ctx.spool.get(origin_id) if origin_id else None
+            obs_costs.add_cache_hit(
+                e.job, origin.cost if origin is not None else None)
+            t0c = time.perf_counter()
             try:
                 with tracing.phase("service_cache_emit"):
                     self._emit(e, rec["weights"], rec["loops"],
@@ -161,7 +173,32 @@ class DispatchWorker(threading.Thread):
                                termination=rec.get("termination") or "")
             except Exception as exc:  # noqa: BLE001 — isolate the one job
                 self._fail(e.job, f"cache-hit emission failed: {exc}")
+            finally:
+                self._record_cost(e.job, phases={
+                    "cache_emit": time.perf_counter() - t0c})
         return misses
+
+    def _record_cost(self, job, phases: dict | None = None) -> None:
+        """Finalize one TERMINAL job's CostRecord exactly once: stamp the
+        trailing phase seconds, fold it into the replica ledger (which
+        renders the ``ict_cost_*`` counters the fleet federates), and
+        re-persist the manifest so the record rides it (the exec_analysis
+        re-persist pattern — the terminal save already happened).  A job
+        that is still open (mid-retry) is skipped; its accumulators keep
+        growing until the attempt that finishes it."""
+        if job.state not in TERMINAL or getattr(job, "_cost_recorded",
+                                                False):
+            return
+        for phase, dt in (phases or {}).items():
+            if dt:
+                obs_costs.add_phase(job, phase, dt)
+        obs_costs.finalize(job)
+        job._cost_recorded = True
+        try:
+            self.ctx.cost_ledger.record(job.cost)
+            self.ctx.spool.save(job)
+        except Exception:  # noqa: BLE001 — accounting must not fail a
+            pass           # job that already served its result
 
     def _dispatch_routed(self, entries: list[Entry]) -> None:
         ctx = self.ctx
@@ -261,7 +298,17 @@ class DispatchWorker(threading.Thread):
                 dt = time.perf_counter() - t0
                 emit_s[0] += dt
                 tracing.observe_phase("service_emit", dt)
+                obs_costs.add_phase(entries[i].job, "emit", dt)
 
+        # Compile-accounting baseline for this dispatch's cost
+        # attribution: any backend compile the window pays (the jit
+        # compiles run synchronously on this thread) is apportioned
+        # across the bucket's member jobs.  Best-effort in multi-replica
+        # single-process tests (the listener's counters are
+        # process-global); exact in the one-replica-per-process
+        # production layout.
+        compile_before = tracing.counters_snapshot().get(
+            "jax_compile_s", 0.0)
         t0 = time.perf_counter()
         ok = False
         try:
@@ -280,9 +327,25 @@ class DispatchWorker(threading.Thread):
             # incident must not make the mean dispatch latency look healthy,
             # and error=True makes the failure RATE visible on /metrics
             # (service_dispatch_err_n — the fallback-ladder alarm).
-            tracing.observe_phase(
-                "service_dispatch", time.perf_counter() - t0 - emit_s[0],
-                error=not ok)
+            dispatch_s = time.perf_counter() - t0 - emit_s[0]
+            tracing.observe_phase("service_dispatch", dispatch_s,
+                                  error=not ok)
+            # Cost attribution (obs/costs): the EXACT seconds the line
+            # above recorded, split equally across the bucket's member
+            # jobs — failed attempts included, so the per-replica
+            # conservation invariant (Σ attributed device-seconds ==
+            # Δict_service_dispatch_s) holds by construction.
+            compile_s = max(tracing.counters_snapshot().get(
+                "jax_compile_s", 0.0) - compile_before, 0.0)
+            obs_costs.add_dispatch_share([e.job for e in entries],
+                                         dispatch_s, compile_s)
+            if not ok:
+                # A raised dispatch can still have emitted some items
+                # terminal (a partial-emission edge): record those NOW —
+                # the retry drops them from `live`, so the success path
+                # below would never see them again.
+                for e in entries:
+                    self._record_cost(e.job)
             # Peak HBM attributable to the service's batched route, read
             # while this dispatch is the freshest thing in the stats.
             obs_memory.observe_route("sharded_batch")
@@ -290,12 +353,21 @@ class DispatchWorker(threading.Thread):
         # memoized per shape bucket (obs/memory; ICT_EXEC_ANALYSIS=0 opts
         # out), AFTER the device work: the analysis AOT compile must delay
         # telemetry, never the jobs.  Manifests were already written
-        # terminal by on_item, so the analysis is re-persisted onto them
+        # terminal by on_item, so the analysis — and the finalized
+        # CostRecord, bytes/FLOPs apportioned across the K members with
+        # the batch's attainment ratio — is re-persisted onto them
         # (GET /jobs/<id> falls back to the spool after retire()).
         analysis = obs_memory.analyze_batch_route(Db.shape, ctx.clean_cfg)
         if analysis:
+            obs_costs.add_exec_share([e.job for e in entries], analysis,
+                                     dispatch_s)
             for e in entries:
                 e.job.exec_analysis = analysis
+        for e in entries:
+            self._record_cost(e.job)
+            if analysis and not getattr(e.job, "_cost_recorded", False):
+                # Open jobs (mid-retry emission failure edge) still get
+                # the analysis persisted, the historical behavior.
                 try:
                     ctx.spool.save(e.job)
                 except Exception:  # noqa: BLE001 — telemetry must not fail
@@ -309,6 +381,7 @@ class DispatchWorker(threading.Thread):
         from iterative_cleaner_tpu.parallel.batch import finalize_weights
 
         ctx = self.ctx
+        t0 = time.perf_counter()
         try:
             with events.trace_scope(e.job.trace_id), \
                     tracing.phase("service_oracle"):
@@ -321,6 +394,13 @@ class DispatchWorker(threading.Thread):
                            scores=res.test_results)
         except Exception as exc:  # noqa: BLE001 — isolate, report, continue
             self._fail(e.job, str(exc))
+        finally:
+            # Oracle wall seconds are HOST cost, recorded as their own
+            # phase — never device_s (the conservation invariant is
+            # against ict_service_dispatch_s alone; a degraded job keeps
+            # whatever failed-attempt dispatch share it accumulated).
+            self._record_cost(e.job, phases={
+                "oracle": time.perf_counter() - t0})
 
     # --- terminal transitions ---
 

@@ -626,6 +626,11 @@ def test_replica_idem_map_stays_bounded(tmp_path):
     open_job = ctx.new_job("open.npz", idempotency_key="key-open")
     assert ctx.admit(open_job, "key-open") is None
     for i in range(10):
+        # Job ids are time-sortable at MILLISECOND granularity; a fast
+        # machine can mint all ten inside one ms, making the
+        # oldest-evicted assertion a coin flip on the uuid suffix.
+        # Space the mints so the ids genuinely sort by age.
+        time.sleep(0.002)
         job = ctx.new_job(f"j{i}.npz", idempotency_key=f"key-{i}")
         assert ctx.admit(job, f"key-{i}") is None
         job.state = "done"

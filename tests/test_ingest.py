@@ -310,6 +310,9 @@ class TestPerfGateIngestContract:
         # The throughput-tier block the contract grew in r07: a bare {}
         # would (correctly) fail the "no throughput_ratio" check.
         payload["coalesce"] = {"throughput_ratio": 2.5}
+        # The cost-accounting block (ISSUE 15): a bare {} would
+        # (correctly) fail the "no attainment table" check.
+        payload["costs"] = {"attainment": {}}
         payload["donation_ledger"] = dict(base["donation_ledger"])
         assert pg.compare(payload, base, 3.0, 1.15) == []
 

@@ -1,0 +1,196 @@
+"""Docs-vs-exposition drift gate (ISSUE 15 satellite): every metric
+family named in docs/OBSERVABILITY.md and docs/SERVING.md must appear in
+a LIVE exposition — one exercised daemon + one router, scraped over real
+HTTP — or in the explicit conditional-families allowlist below.
+
+The failure mode this kills: a doc table advertising a family that was
+renamed (or never registered) ships operators dashboards over series
+that do not exist.  The allowlist is the honest remainder: families that
+only exist on specific events (failover, straggler flags, scale
+decisions, alert sink deliveries) or specific platforms (TPU memory
+introspection, the persistent compile cache) — each entry says why.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+import urllib.request
+
+import pytest
+
+from test_fleet import (
+    _await_fleet_terminal,
+    _get,
+    _post_job,
+    _start_replica,
+    _start_router,
+    _write,
+)
+from iterative_cleaner_tpu.obs import metrics as obs_metrics
+from iterative_cleaner_tpu.service.jobs import TERMINAL
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOCS = (os.path.join(REPO, "docs", "OBSERVABILITY.md"),
+        os.path.join(REPO, "docs", "SERVING.md"))
+
+#: Families the docs legitimately name but a CPU-backed offline
+#: mini-fleet cannot produce — each entry carries its condition.
+CONDITIONAL_FAMILIES = {
+    # TPU/GPU memory introspection: CPU backends report no memory_stats()
+    "ict_hbm_bytes_in_use",
+    "ict_hbm_peak_bytes_in_use",
+    "ict_hbm_bytes_limit",
+    "ict_route_hbm_peak_bytes",
+    "ict_route_hbm_bytes_in_use",
+    # event-conditional router counters: need a failover / dead replica /
+    # straggler / scale decision / alert-sink delivery, none of which
+    # this healthy mini-fleet produces
+    "ict_fleet_failovers_total",
+    "ict_fleet_incidents_total",
+    "ict_fleet_slo_burn_total",
+    "ict_fleet_straggler_flags_total",
+    "ict_fleet_scale_events_total",
+    "ict_fleet_alert_notifications_total",
+    "ict_fleet_replica_p50_seconds",   # needs >= min_count windowed obs
+    "ict_fleet_cache_skips_total",     # needs an oversize/mixed-salt skip
+    # event-conditional replica counters
+    "ict_audit_drift_exceeded",        # needs score drift past the bound
+    "ict_audit_skipped",               # needs audit-queue backpressure
+    "ict_jobs_terminated_total",       # needs a termination-classified
+                                       # serve (oracle route / forensics)
+    "ict_rfi_zaps_attributed_total",   # needs ICT_FORENSICS=1 timelines
+    "ict_fleet_replica_bucket_queue_depth",  # needs cubes PARKED at the
+                                       # instant of a health poll
+}
+
+#: ``ict_``-prefixed doc tokens that are tools/paths, not metric
+#: families (`tools/ict_lint.py`, the default spool directories).
+NON_METRIC_TOKENS = {"ict_lint", "ict_repro", "ict_fleet_spool",
+                     "ict_serve_spool"}
+
+
+def _doc_tokens() -> tuple[set, set]:
+    """(exact family names, prefix tokens) named across the two docs.
+    A trailing-underscore token (`ict_fleet_capacity_*` in prose) is a
+    PREFIX: at least one live family must start with it."""
+    text = ""
+    for path in DOCS:
+        with open(path) as fh:
+            text += fh.read()
+    # Lookbehind kills path occurrences (./ict_repro, tools/ict_lint.py);
+    # the NON_METRIC_TOKENS set covers the backticked tool mentions.
+    tokens = set(re.findall(r"(?<![/\w])ict_[a-zA-Z0-9_]*", text))
+    tokens -= NON_METRIC_TOKENS
+    exact = {t for t in tokens if not t.endswith("_")}
+    prefixes = {t for t in tokens if t.endswith("_") and len(t) > len(
+        "ict_")}
+    return exact, prefixes
+
+
+def _live_names(texts: list[str]) -> set:
+    names = set()
+    for text in texts:
+        for fam in obs_metrics.parse_exposition(text):
+            names.add(fam.name)
+            for sample_name, _labels, _raw in fam.samples:
+                names.add(sample_name)
+    return names
+
+
+def _http_text(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return resp.read().decode()
+
+
+def test_documented_families_exist_live(tmp_path):
+    """Stand up one jax replica + one router, drive every cheap series
+    producer (a coalesced dispatch, a shadow audit, replica- and
+    fleet-tier cache hits, a tenant budget, one firing alert), scrape
+    /metrics + /fleet/metrics, and require every documented family to be
+    live or allowlisted — and every allowlist entry to still be
+    documented (a stale allowlist is drift in the other direction)."""
+    paths = [_write(tmp_path, f"d{i}.npz", seed=600 + i) for i in range(2)]
+    svc = _start_replica(tmp_path, "doc-a", backend="jax",
+                         bucket_cap=1, coalesce=2, deadline_s=30.0)
+    router = _start_router(
+        svc, tenant_budgets={"survey": 100.0},
+        alert_rules=({
+            "name": "doc_drift_probe", "severity": "info",
+            "family": "ict_fleet_replicas",
+            "labels": {"state": "alive"},
+            "predicate": {"op": "ge", "value": 0}, "for_ticks": 1,
+            "description": "always-firing probe: populates the alert "
+                           "counter families for the drift check"},))
+    try:
+        replies = [_post_job(router, {"path": p, "shape": [4, 16, 64],
+                                      "audit": i == 0},
+                             headers={"X-ICT-Tenant": "survey"})
+                   for i, p in enumerate(paths)]
+        _await_fleet_terminal(router, [r["id"] for r in replies],
+                              timeout_s=240)
+        # fleet-tier cache hit (born terminal) + replica-tier cache hit
+        router.poll_tick()
+        dup = _post_job(router, {"path": paths[0]})
+        assert dup.get("served_by") == "fleet-cache"
+        direct = svc.submit(paths[1], idempotency_key="doc-fresh-1")
+        deadline = time.time() + 60
+        while (svc.scheduler.pending_count() < 1
+               and time.time() < deadline):
+            time.sleep(0.02)
+        svc.scheduler.flush_all()
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            rec = svc.job(direct.id)
+            if rec is not None and rec.state in TERMINAL:
+                break
+            time.sleep(0.05)
+        svc.auditor.drain(60)
+        time.sleep(0.3)   # one tick-loop pass: RSS/spool-disk gauges
+        for _ in range(2):
+            router.poll_tick()
+        live = _live_names([
+            _http_text(f"http://127.0.0.1:{svc.port}/metrics"),
+            _http_text(f"http://127.0.0.1:{router.port}/metrics"),
+            _http_text(f"http://127.0.0.1:{router.port}/fleet/metrics"),
+        ])
+    finally:
+        router.stop()
+        svc.stop()
+
+    exact, prefixes = _doc_tokens()
+    hist_suffixes = ("_bucket", "_sum", "_count")
+
+    def covered(token: str) -> bool:
+        if token in live or token in CONDITIONAL_FAMILIES:
+            return True
+        for sfx in hist_suffixes:   # doc names a histogram sample
+            if token.endswith(sfx) and token[: -len(sfx)] in live:
+                return True
+        # a conditional family's merged twin is conditional too
+        if token.startswith("ict_fleet_") and (
+                "ict_" + token[len("ict_fleet_"):]
+                in CONDITIONAL_FAMILIES):
+            return True
+        return False
+
+    missing = sorted(t for t in exact if not covered(t))
+    assert not missing, (
+        f"documented metric families absent from the live exposition "
+        f"and the conditional allowlist: {missing}")
+    live_or_listed = live | CONDITIONAL_FAMILIES
+    dead_prefixes = sorted(
+        p for p in prefixes
+        if not any(name.startswith(p) for name in live_or_listed))
+    assert not dead_prefixes, (
+        f"documented family prefixes with no live match: {dead_prefixes}")
+    # drift in the other direction: every allowlist entry must still be
+    # documented (or it is dead weight hiding future drift) and must
+    # genuinely be absent from this run's exposition (or the condition
+    # has become unconditional and the entry should go).
+    undocumented = sorted(t for t in CONDITIONAL_FAMILIES
+                          if t not in exact)
+    assert not undocumented, (
+        f"allowlist entries no longer documented: {undocumented}")

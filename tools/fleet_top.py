@@ -3,15 +3,17 @@
 
 ``python tools/fleet_top.py --router http://host:8790`` fetches the
 router's ``/healthz``, ``GET /fleet/capacity``, ``GET /fleet/alerts``,
-and ``GET /fleet/metrics`` and prints one human-readable snapshot:
-per-replica state (alive/draining/dead, straggler and
-autoscale-managed flags, queue depths, utilization, service rate,
-dispatch p50), per-bucket backlog/demand/drain-ETA rows, the fleet
-totals, the autoscaler state, and a FIRING ALERTS section off the
+``GET /fleet/costs``, and ``GET /fleet/metrics`` and prints one
+human-readable snapshot: per-replica state (alive/draining/dead,
+straggler and autoscale-managed flags, queue depths, utilization,
+service rate, dispatch p50), per-bucket backlog/demand/drain-ETA rows
+(with roofline attainment), the fleet totals, the autoscaler state, a
+TENANTS showback section off the cost plane (device-seconds, jobs,
+cache savings, budget burn), and a FIRING ALERTS section off the
 alerting plane.  ``--json`` prints the same snapshot as ONE JSON line
 for scripting (the bench.py one-line contract); ``--watch N``
 re-renders every N seconds until interrupted (one JSON line per
-refresh in ``--json`` mode).  Read-only: four GETs, no mutation, safe
+refresh in ``--json`` mode).  Read-only: five GETs, no mutation, safe
 against a production router.
 
 Offline-smoke-testable: tests stand up an in-process fleet and point
@@ -56,6 +58,10 @@ def collect(base: str, timeout_s: float = 10.0) -> dict:
         alerts = _get_json(base, "/fleet/alerts", timeout_s)
     except (urllib.error.URLError, OSError, ValueError):
         alerts = {}   # pre-alerting routers still render everything else
+    try:
+        costs = _get_json(base, "/fleet/costs", timeout_s)
+    except (urllib.error.URLError, OSError, ValueError):
+        costs = {}    # pre-costs routers still render everything else
     p50s: dict[str, float] = {}
     scale_events = 0.0
     # bucket -> {k -> dispatch count} (the merged fleet-wide coalesce
@@ -95,6 +101,7 @@ def collect(base: str, timeout_s: float = 10.0) -> dict:
         "health": health,
         "capacity": capacity,
         "alerts": alerts,
+        "costs": costs,
         "p50s": p50s,
         "scale_events_total": scale_events,
         "coalesce_p50s": {b: dispatch_size_p50(sizes)
@@ -179,20 +186,25 @@ def render(snap: dict) -> str:
     buckets = capacity.get("buckets", {})
     co_p50s = snap.get("coalesce_p50s") or {}
     hit_rates = snap.get("cache_hit_rates") or {}
-    if buckets or co_p50s or hit_rates:
+    cost_buckets = (snap.get("costs") or {}).get("buckets") or {}
+    if buckets or co_p50s or hit_rates or cost_buckets:
         lines += ["", f"{'BUCKET':<16} {'BACKLOG':>8} {'DEMAND/S':>9} "
                       f"{'ETA_S':>8} {'COST_B':>10} {'CO_P50':>7} "
-                      f"{'HIT%':>6}"]
-        for bucket in sorted({*buckets, *co_p50s, *hit_rates}):
+                      f"{'HIT%':>6} {'ATTAIN':>7}"]
+        for bucket in sorted({*buckets, *co_p50s, *hit_rates,
+                              *cost_buckets}):
             rec = buckets.get(bucket, {})
             rate = hit_rates.get(bucket)
+            crec = cost_buckets.get(bucket, {})
             lines.append(
                 f"{bucket:<16} {_fmt_num(rec.get('backlog')):>8} "
                 f"{_fmt_num(rec.get('demand_rate')):>9} "
                 f"{_fmt_num(rec.get('eta_s')):>8} "
                 f"{_fmt_num(rec.get('cost_bytes')):>10} "
                 f"{_fmt_num(co_p50s.get(bucket)):>7} "
-                f"{_fmt_num(round(rate * 100, 1)) if rate is not None else '-':>6}")
+                f"{_fmt_num(round(rate * 100, 1)) if rate is not None else '-':>6} "
+                f"{_fmt_num(crec.get('attainment')):>7}")
+    lines += render_tenants(snap.get("costs") or {})
     fleet = capacity.get("fleet", {})
     if fleet:
         fc = snap.get("fleet_cache") or {}
@@ -222,6 +234,34 @@ def render(snap: dict) -> str:
         lines += ["autoscale off"]
     lines += render_alerts(snap.get("alerts") or {})
     return "\n".join(lines)
+
+
+def render_tenants(costs: dict) -> list[str]:
+    """The TENANTS showback section (from ``GET /fleet/costs``): one row
+    per tenant — attributed device-seconds, jobs, cache savings (the
+    device-seconds the content caches avoided for this tenant), and the
+    advisory budget burn; the section header carries the best observed
+    roofline attainment so efficiency sits next to consumption."""
+    tenants = costs.get("tenants") or {}
+    if not tenants:
+        return []
+    attains = [rec.get("attainment")
+               for rec in (costs.get("buckets") or {}).values()
+               if rec.get("attainment") is not None]
+    head = "TENANTS" + (f"  (best attainment {_fmt_num(max(attains))})"
+                        if attains else "")
+    lines = ["", head,
+             f"{'TENANT':<16} {'DEVICE_S':>10} {'JOBS':>6} "
+             f"{'SAVED_S':>8} {'BUDGET%':>8}"]
+    for tenant in sorted(tenants):
+        rec = tenants[tenant]
+        pct = rec.get("budget_used_pct")
+        lines.append(
+            f"{tenant:<16} {_fmt_num(rec.get('device_s')):>10} "
+            f"{_fmt_num(rec.get('jobs')):>6} "
+            f"{_fmt_num(rec.get('avoided_device_s')):>8} "
+            f"{_fmt_num(pct) if pct is not None else '-':>8}")
+    return lines
 
 
 def render_alerts(alerts: dict) -> list[str]:

@@ -142,7 +142,7 @@ STATIC_KEYS = ("step_dense_bytes_cubes", "step_incremental_bytes_cubes",
 #: throughput + content-cache round-trip, parity-flagged).
 REQUIRED_KEYS = ("metric", "value", "unit", "vs_baseline",
                  "compile_accounting", "memory", "audit", "ingest",
-                 "coalesce")
+                 "coalesce", "costs")
 
 #: The tentpole's acceptance bar: the baseline must have demonstrated
 #: >= 50% upload/compute overlap for the floor check to arm at all.
@@ -306,6 +306,20 @@ def compare(payload: dict, baseline: dict, ratio_tolerance: float,
                 f"longer beats K solo dispatches (a lost batch lowering "
                 f"reads ~1.0)")
 
+    # Cost-accounting contract (ISSUE 15): the costs block must exist on
+    # every exit path (REQUIRED_KEYS) and, when the dedicated section
+    # ran, must not have errored and must carry the attainment table —
+    # a payload whose efficiency figures silently vanished would let a
+    # roofline regression land unmeasured.
+    costs = payload.get("costs")
+    if isinstance(costs, dict):
+        if costs.get("error"):
+            problems.append(
+                f"costs section errored: {costs['error']!r} — the "
+                "cost-accounting arm did not measure")
+        elif "attainment" not in costs:
+            problems.append("costs block has no attainment table")
+
     # Donation ledger: ZERO tolerance.  A drifted ledger means a donation
     # vanished (silent perf regression) or appeared unregistered
     # (correctness hazard) — and ICT009 would fail CI anyway; failing here
@@ -408,6 +422,7 @@ def history_line(payload: dict, ok: bool) -> dict:
         "ingest_codec_ratio": ing.get("codec_ratio"),
         "coalesce_throughput_ratio": (payload.get("coalesce") or {}
                                       ).get("throughput_ratio"),
+        "roofline_attainment": payload.get("roofline_attainment"),
         "ts": round(time.time(), 3),
         "ok": ok,
         "device": payload.get("device"),
