@@ -55,6 +55,9 @@ RACE_SCOPE_PREFIXES = (
     "iterative_cleaner_tpu/service/",
     "iterative_cleaner_tpu/obs/",
     "iterative_cleaner_tpu/fleet/",
+    # ISSUE 16: the campaign orchestrator's tables and the spool store —
+    # its lock orders after the router's (campaign/orchestrator.py).
+    "iterative_cleaner_tpu/campaign/",
 )
 
 LOCK_FACTORIES = {"Lock", "RLock"}

@@ -224,6 +224,13 @@ def main(argv: list[str] | None = None) -> int:
         from iterative_cleaner_tpu.service.daemon import serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "campaign" and not os.path.isfile("campaign"):
+        # Submit-and-follow a survey campaign against a fleet router
+        # (docs/SERVING.md "Campaigns"); same literal-token dispatch rule
+        # as ``serve``.
+        from iterative_cleaner_tpu.campaign.cli import campaign_main
+
+        return campaign_main(argv[1:])
     if argv and argv[0] == "serve-fleet" and not os.path.isfile("serve-fleet"):
         # The fleet router in front of N daemon replicas (docs/SERVING.md
         # "Fleet"); same literal-token dispatch rule as ``serve``, and

@@ -108,9 +108,18 @@ class ReplicaClient:
                trace_id: str = "") -> dict:
         """POST /jobs on one replica; the trace context crosses the hop in
         the X-ICT-Trace header (the replica adopts it instead of minting),
-        so the event log threads placement -> dispatch under one id."""
-        headers = {"X-ICT-Trace": trace_id} if trace_id else None
-        return self._call(f"{base_url}/jobs", body=payload, headers=headers)
+        so the event log threads placement -> dispatch under one id.  The
+        payload-stamped tenant ALSO rides the X-ICT-Tenant header — the
+        replica reads body first, header second, so this is belt and
+        braces keeping failover re-routes and direct replica submissions
+        on the same attribution path (service/api.py)."""
+        headers = {}
+        if trace_id:
+            headers["X-ICT-Trace"] = trace_id
+        if payload.get("tenant"):
+            headers["X-ICT-Tenant"] = str(payload["tenant"])
+        return self._call(f"{base_url}/jobs", body=payload,
+                          headers=headers or None)
 
     def job(self, base_url: str, job_id: str) -> dict:
         return self._call(f"{base_url}/jobs/{job_id}")

@@ -50,6 +50,8 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from iterative_cleaner_tpu.campaign.orchestrator import CampaignOrchestrator
+from iterative_cleaner_tpu.campaign.store import CampaignStore
 from iterative_cleaner_tpu.fleet import alerts as fleet_alerts
 from iterative_cleaner_tpu.fleet import autoscale as fleet_autoscale
 from iterative_cleaner_tpu.fleet import cache as fleet_cache
@@ -446,6 +448,22 @@ class FleetRouter:
         self.metrics.replace_gauge_family(
             "fleet_tenant_budget_used_pct",
             {(("tenant", t),): 0.0 for t in cfg.tenant_budgets})
+        # The survey-campaign orchestrator (campaign/): spool-persisted
+        # under <spool>/campaigns/, rehydrated NOW so a restarted router
+        # resumes open campaigns from its first poll tick.  Its lock
+        # orders strictly after the router's: it snapshots its own state,
+        # calls place_job/job_manifest UNLOCKED, then re-acquires to
+        # record (campaign/orchestrator.py).
+        self.campaigns = CampaignOrchestrator(
+            CampaignStore(os.path.join(cfg.spool_dir, "campaigns")),
+            self, quiet=cfg.quiet)
+        # Pre-register every ict_campaign_* gauge family — zero-valued
+        # aggregates plus whatever the rehydrate brought back — so the
+        # documented families are live on every exposition from the
+        # first scrape (the budget-gauge pre-registration lesson;
+        # tests/test_metric_docs.py), not only once a campaign exists.
+        for family, entries in self.campaigns.gauge_families().items():
+            self.metrics.replace_gauge_family(family, entries)
         # Last observed (audit_divergences, backend) per replica: the
         # incident watch fires a bundle when divergences move or a
         # replica demotes jax -> numpy between polls.
@@ -569,6 +587,7 @@ class FleetRouter:
         self._update_replica_gauges()
         self._update_capacity()
         self._update_costs()
+        self._campaign_tick()
         self._autoscale_tick()
         self._history_alert_tick()
         self._trim_placements()
@@ -877,6 +896,18 @@ class FleetRouter:
                 snap, self.cfg.tenant_budgets).items():
             self.metrics.replace_gauge_family(family, entries)
 
+    def _campaign_tick(self) -> None:
+        """Advance every open campaign (observe placed archives, submit
+        pending ones under their pinned idempotency keys, finish settled
+        campaigns — campaign/orchestrator.py) and republish the
+        ``ict_campaign_*`` gauge families whole, the capacity/cost
+        snapshot-then-replace discipline.  Runs right after the cost
+        fold so a tick that completes an archive also sees its
+        CostRecord land in the same pass."""
+        self.campaigns.tick()
+        for family, entries in self.campaigns.gauge_families().items():
+            self.metrics.replace_gauge_family(family, entries)
+
     def _autoscale_tick(self) -> None:
         """The control loop's acting half: reap finished drains, ask the
         Autoscaler for this tick's verdict, and (in act mode) execute it
@@ -1156,6 +1187,15 @@ class FleetRouter:
         """Admit + grant + place one submission; returns the 202 body.
         Raises QuotaExceeded (-> 429), FleetBusy (-> 503), ReplicaRefused
         (the replica's own 4xx passes through)."""
+        # The tenant is stamped INTO the payload here, authoritatively —
+        # not just by the HTTP handler — so every in-process caller (the
+        # campaign orchestrator) and every failover re-route of this
+        # payload carries the same identity the admission ledger and the
+        # cost showback charged; a payload already stamped (a retried
+        # submission) keeps its tenant rather than silently rebranding
+        # to the default.
+        tenant = str(tenant or payload.get("tenant", "") or DEFAULT_TENANT)
+        payload["tenant"] = tenant
         key = str(payload.get("idempotency_key", "") or "")
         known = self._resolve_idem(key)
         if known is not None:
@@ -1823,6 +1863,10 @@ class FleetRouter:
             # a load balancer or fleet_top to see "something is firing"
             # without a second request; GET /fleet/alerts has the rest.
             "alerts": self._alerts_summary(),
+            # The campaign plane (campaign/): open-campaign count,
+            # aggregate archive states, and recent per-campaign rows —
+            # the fleet_top CAMPAIGNS section's feed.
+            "campaigns": _json_safe(self.campaigns.summary()),
             # The fleet result cache (fleet/cache.py): index size and
             # cumulative hit/miss counters, summarized for fleet_top.
             "result_cache": {
@@ -1891,12 +1935,15 @@ class _RouterHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _read_body(self) -> bytes:
+    def _read_body(self, limit: int = 1 << 20) -> bytes:
+        # POST /campaigns raises the cap to 8 MB: a survey manifest
+        # listing tens of thousands of absolute paths is legitimate
+        # input, while every other route keeps the tight default.
         try:
             n = int(self.headers.get("Content-Length", 0))
         except (TypeError, ValueError):
             n = 0
-        return self.rfile.read(max(0, min(n, 1 << 20)))
+        return self.rfile.read(max(0, min(n, limit)))
 
     def do_GET(self) -> None:  # noqa: N802 — stdlib signature
         router = self.server.router
@@ -1944,6 +1991,16 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 "incidents": fleet_obs.list_incidents(router.incident_dir)})
         elif self.path == "/replicas":
             self._reply(200, {"replicas": router.registry.snapshot()})
+        elif self.path == "/campaigns":
+            self._reply(200, {"campaigns": _json_safe(
+                router.campaigns.list())})
+        elif self.path.startswith("/campaigns/"):
+            cid = self.path[len("/campaigns/"):]
+            view = router.campaigns.get(cid)
+            if view is None:
+                self._reply(404, {"error": f"no campaign {cid!r}"})
+            else:
+                self._reply(200, _json_safe(view))
         elif self.path.startswith("/jobs/"):
             jid = self.path[len("/jobs/"):]
             code, payload = router.job_manifest(jid)
@@ -1955,6 +2012,29 @@ class _RouterHandler(BaseHTTPRequestHandler):
         router = self.server.router
         if self.path == "/jobs":
             self._post_job()
+            return
+        if self.path == "/campaigns":
+            try:
+                manifest = json.loads(
+                    self._read_body(limit=8 << 20) or b"{}")
+            except ValueError as exc:
+                self._reply(400, {"error": f"bad manifest JSON: {exc}"})
+                return
+            try:
+                row = router.campaigns.create(manifest)
+            except ValueError as exc:
+                self._reply(400, {"error": str(exc)})
+                return
+            self._reply(200, _json_safe(row))
+            return
+        if (self.path.startswith("/campaigns/")
+                and self.path.endswith("/cancel")):
+            cid = self.path[len("/campaigns/"): -len("/cancel")]
+            row = router.campaigns.cancel(cid)
+            if row is None:
+                self._reply(404, {"error": f"no campaign {cid!r}"})
+            else:
+                self._reply(200, _json_safe(row))
             return
         if (self.path.startswith("/replicas/")
                 and self.path.endswith("/drain")):
@@ -2381,7 +2461,12 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
     with merged counters exactly equal to the per-replica sums and a
     nonzero ``fleet_jobs_completed``, the induced failover yields a
     stitched ``GET /fleet/trace`` spanning both replicas, and at least
-    one incident bundle lands on disk.  One JSON line, rc 0/1 — the CI
+    one incident bundle lands on disk.  A campaign lane (ISSUE 16) then
+    runs a small survey manifest through ``POST /campaigns`` — one
+    duplicate archive served born-terminal by the fleet result cache, a
+    late-joined third replica killed mid-campaign — and asserts
+    exactly-once completion, oracle-identical masks, and a QA roll-up +
+    per-campaign cost row on the view.  One JSON line, rc 0/1 — the CI
     lane next to ``serve --smoke``."""
     import tempfile
     import urllib.request
@@ -2466,6 +2551,7 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
         }))
         router.start()
         jobs = {}
+        svc_c = None    # the campaign lane's late-joining third replica
         try:
             base = f"http://{router.cfg.host}:{router.port}"
             before_done = tracing.counters_snapshot().get(
@@ -2672,6 +2758,103 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
             cache_ok = (dup.get("served_by") == "fleet-cache"
                         and fleet_cache_hits >= 1 and dup_no_work
                         and dup_masks_ok)
+            # --- the campaign lane (ISSUE 16), end to end ---
+            # A small survey manifest through POST /campaigns: the
+            # orchestrator places every archive through the SAME ranked
+            # placement path under campaign-scoped idempotency keys.  A
+            # third parked replica joins the fleet at runtime and is
+            # killed mid-campaign (the failover story again, now under
+            # campaign keys); one manifest entry duplicates an archive
+            # the fleet already cleaned, so it must be served
+            # born-terminal by the result cache.  Asserted: the campaign
+            # reaches "done" with every archive done, the jobs-done
+            # ledger moves by the FRESH archive count only (exactly
+            # once — duplicates and failovers add nothing), >= 1
+            # fleet-cache hit, masks bit-identical to the solo numpy
+            # oracle, and the view carries a QA roll-up plus a cost row
+            # with real device-seconds and the dedupe dividend.
+            svc_c = CleaningService(serve_cfg("c", tmp, deadline_s=3600.0,
+                                              bucket_cap=8))
+            svc_c.start()
+            router.registry.add(f"http://127.0.0.1:{svc_c.port}")
+            camp_paths = []
+            for i in range(4):
+                p3 = os.path.join(tmp, f"survey{i}.npz")
+                NpzIO().save(make_archive(nsub=4, nchan=16, nbin=64,
+                                          seed=620 + i), p3)
+                camp_paths.append(p3)
+            camp_done_before = tracing.counters_snapshot().get(
+                "service_jobs_done", 0)
+            camp_cache_before = router.metrics.counter_total(
+                "fleet_cache_hits_total")
+            camp_req = urllib.request.Request(
+                f"{base}/campaigns",
+                data=json.dumps({
+                    "name": "smoke-survey", "tenant": "smokesurvey",
+                    "archives": camp_paths + [paths[0]],
+                    "config": {"lane": "serve-fleet --smoke"},
+                }).encode(),
+                headers={"Content-Type": "application/json"})
+            camp_row = json.load(urllib.request.urlopen(camp_req,
+                                                        timeout=30))
+            camp_id = camp_row["id"]
+            # Kill replica c once campaign work is PARKED on it (decoded,
+            # bucketed, undispatched — the worst failover case), or once
+            # the campaign outran the placement race and finished
+            # entirely on b; either way the crash lands while the run is
+            # live whenever there is anything on c to fail over.
+            camp_view: dict = {}
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                health_c = json.load(urllib.request.urlopen(
+                    f"http://127.0.0.1:{svc_c.port}/healthz", timeout=10))
+                camp_view = json.load(urllib.request.urlopen(
+                    f"{base}/campaigns/{camp_id}", timeout=10))
+                if (health_c.get("bucketed_cubes", 0) >= 1
+                        or camp_view.get("state") != "open"):
+                    break
+                time.sleep(0.05)
+            svc_c.stop()    # the mid-campaign crash
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                camp_view = json.load(urllib.request.urlopen(
+                    f"{base}/campaigns/{camp_id}", timeout=10))
+                if camp_view.get("state") != "open":
+                    break
+                time.sleep(0.1)
+            camp_done_delta = tracing.counters_snapshot().get(
+                "service_jobs_done", 0) - camp_done_before
+            camp_cache_hits = router.metrics.counter_total(
+                "fleet_cache_hits_total") - camp_cache_before
+            camp_masks_ok = camp_view.get("state") == "done"
+            if camp_masks_ok:
+                cfg_np = CleanConfig(backend="numpy")
+                for rec in camp_view["archive_records"]:
+                    want, _rfi = finalize_weights(
+                        clean_cube(*preprocess(NpzIO().load(rec["path"])),
+                                   cfg_np).weights, cfg_np)
+                    got = NpzIO().load(rec["out_path"])
+                    if not np.array_equal(got.weights, want):
+                        camp_masks_ok = False
+            camp_rollup = camp_view.get("rollup") or {}
+            camp_cost = camp_view.get("cost") or {}
+            camp_metrics_text = urllib.request.urlopen(
+                f"{base}/metrics", timeout=10).read().decode()
+            campaign_ok = (
+                camp_view.get("state") == "done"
+                and camp_view.get("archives", {}).get("done", 0)
+                == len(camp_paths) + 1
+                and camp_done_delta == len(camp_paths)
+                and camp_cache_hits >= 1
+                and camp_masks_ok
+                and camp_rollup.get("jobs") == len(camp_paths) + 1
+                and camp_rollup.get("with_quality") == len(camp_paths) + 1
+                and camp_cost.get("jobs_costed") == len(camp_paths) + 1
+                and camp_cost.get("device_s", 0.0) > 0
+                and camp_cost.get("cache_hits", 0) >= 1
+                and camp_cost.get("avoided_device_s", 0.0) > 0
+                and (f'ict_campaign_device_seconds{{campaign="{camp_id}"}}'
+                     in camp_metrics_text))
             # --- the cost-accounting plane (ISSUE 15), end to end ---
             # A tenant-tagged job burns through the injected tiny
             # budget; the costs lane then asserts (a) attribution
@@ -2763,7 +2946,7 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                   and done_delta == len(paths)
                   and fleet_ok and trace_ok and len(incidents) >= 1
                   and alerts_ok and coalesce_ok and cache_ok
-                  and costs_ok
+                  and campaign_ok and costs_ok
                   and health_b.get("audits_run", 0) >= 1
                   and health_b.get("audit_divergences", 0) == 0)
             result = {
@@ -2785,6 +2968,14 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                 "coalesce_masks_ok": bool(co_masks_ok),
                 "fleet_cache_hits": int(fleet_cache_hits),
                 "fleet_cache_hit_ok": bool(cache_ok),
+                "campaign_lane_ok": bool(campaign_ok),
+                "campaign_state": camp_view.get("state"),
+                "campaign_archives_done": int(
+                    camp_view.get("archives", {}).get("done", 0)),
+                "campaign_jobs_delta": int(camp_done_delta),
+                "campaign_cache_hits": int(camp_cache_hits),
+                "campaign_masks_ok": bool(camp_masks_ok),
+                "campaign_device_s": camp_cost.get("device_s"),
                 "costs_lane_ok": bool(costs_ok),
                 "cost_conservation_ratio": (
                     round(cost_sum / dispatch_sum, 4)
@@ -2796,13 +2987,15 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                 "placements": {
                     rid: int(router.metrics.counter_value(
                         "fleet_placements_total", {"replica": rid}))
-                    for rid in ("smoke-a", "smoke-b")},
+                    for rid in ("smoke-a", "smoke-b", "smoke-c")},
             }
             return 0 if ok else 1
         finally:
             print(json.dumps(result))
             router.stop()
             svc_b.stop()
+            if svc_c is not None:
+                svc_c.stop()    # idempotent if the lane already killed it
 
 
 def run_autoscale_smoke(cfg: FleetConfig) -> int:

@@ -52,11 +52,34 @@ _fh = None                  # cached append handle for the active path  # ict: g
 _fh_path: str | None = None  # ict: guarded-by(_lock)
 _warned = False  # ict: guarded-by(_lock)
 _retry_at = 0.0             # sink-failure backoff deadline (monotonic)  # ict: guarded-by(_lock)
+_fh_size = 0                # bytes in the active sink file (tracked, not stat-ed per emit)  # ict: guarded-by(_lock)
+_rotations = 0              # size-cap rotations this process has performed  # ict: guarded-by(_lock)
 
 #: After a failed sink write, drop events for this long, then try again —
 #: transient disk trouble (brief ENOSPC, a remounted log volume) must not
 #: silence a weeks-lived daemon's event log forever.
 SINK_RETRY_S = 60.0
+
+#: Default size cap (MB) on the sink file before it rotates to
+#: ``<path>.1`` (one rotated generation, so the disk footprint is bounded
+#: at ~2x the cap); ``ICT_EVENT_LOG_MAX_MB`` overrides, 0 disables
+#: rotation entirely.  Rotation is a close + rename + reopen inside the
+#: emit path's existing OSError envelope — it can never block or raise.
+EVENT_LOG_MAX_MB = 256
+
+
+def _max_bytes() -> int:
+    try:
+        mb = float(os.environ.get("ICT_EVENT_LOG_MAX_MB", EVENT_LOG_MAX_MB))
+    except ValueError:
+        mb = EVENT_LOG_MAX_MB
+    return int(mb * (1 << 20)) if mb > 0 else 0
+
+
+def rotations() -> int:
+    """Size-cap rotations performed by this process (tests, /healthz)."""
+    with _lock:
+        return _rotations
 
 
 def new_trace_id() -> str:
@@ -144,7 +167,7 @@ def emit(event: str, trace_id: str | None = None, span_id: str | None = None,
     failing sink (full disk, yanked directory) drops events for
     ``SINK_RETRY_S`` with one stderr warning, then tries again, rather
     than failing the clean it was observing or going silent forever."""
-    global _fh, _fh_path, _warned, _retry_at
+    global _fh, _fh_path, _warned, _retry_at, _fh_size, _rotations
     ctx = _current.get()
     tid = trace_id if trace_id is not None else (ctx.trace_id if ctx else "")
     sid = span_id if span_id is not None else (ctx.span_id if ctx else "")
@@ -174,8 +197,29 @@ def emit(event: str, trace_id: str | None = None, span_id: str | None = None,
                     _fh.close()
                 _fh = open(path, "a")
                 _fh_path = path
+                # Size is tracked, not stat-ed per emit: seeded from the
+                # file once at open, advanced by the bytes we write
+                # (json.dumps is ensure_ascii, so len(line) IS the byte
+                # count) — append-mode tell() semantics never enter it.
+                _fh_size = os.path.getsize(path)
+            cap = _max_bytes()
+            if cap and _fh_size + len(line) > cap:
+                # Size-cap rotation (ICT_EVENT_LOG_MAX_MB): the current
+                # file becomes <path>.1 (replacing the previous rotated
+                # generation — disk stays bounded at ~2x the cap) and the
+                # sink continues into a fresh file.  A close + rename +
+                # reopen under the lock we already hold; any failure
+                # lands in the OSError envelope below, so rotation can
+                # degrade to the normal drop-and-retry backoff but never
+                # block or break the emit path.
+                _fh.close()
+                os.replace(path, path + ".1")
+                _fh = open(path, "a")
+                _fh_size = 0
+                _rotations += 1
             _fh.write(line)
             _fh.flush()
+            _fh_size += len(line)
             _retry_at = 0.0
         except OSError as exc:
             _retry_at = time.monotonic() + SINK_RETRY_S

@@ -1,0 +1,416 @@
+"""Survey-campaign orchestration end to end (ISSUE 16).
+
+The acceptance contract: a 20+-archive campaign spread across 2 replicas
+with one mid-run replica kill completes exactly-once (the shared
+jobs-done ledger unmoved by duplicate archives, which resolve
+born-terminal out of the fleet result cache), every mask bit-identical
+to a solo numpy-oracle clean, and a router restart mid-campaign resumes
+from the spool without re-cleaning terminal archives.  GET
+/campaigns/<id> serves the QA roll-up and a cost showback that
+reconciles with the fleet cost plane.
+
+Timing discipline is test_fleet's: dormant poll loops, tests drive
+``poll_tick()`` by hand (the CLI follow test is the one exception — the
+client needs a live loop to follow).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from test_fleet import (
+    _get,
+    _oracle_weights,
+    _start_replica,
+    _start_router,
+    _write,
+)
+from iterative_cleaner_tpu.campaign.manifest import (
+    archive_idem_key,
+    compile_manifest,
+)
+from iterative_cleaner_tpu.io.npz import NpzIO
+from iterative_cleaner_tpu.obs import events
+from iterative_cleaner_tpu.utils import tracing
+
+
+def _post(router, route, body, expect_error=False):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router.port}{route}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        return json.load(urllib.request.urlopen(req, timeout=30))
+    except urllib.error.HTTPError as exc:
+        if expect_error:
+            return exc
+        raise
+
+
+def _drive(router, cid, until=None, timeout_s=120.0):
+    """Drive poll ticks until the campaign satisfies ``until`` (default:
+    terminal state); returns the final GET /campaigns/<id> view."""
+    deadline = time.time() + timeout_s
+    view = {}
+    while time.time() < deadline:
+        router.poll_tick()
+        view = _get(router, f"/campaigns/{cid}")
+        if until(view) if until is not None else (
+                view["state"] != "open"
+                and not view["archives"]["placed"]):
+            return view
+        time.sleep(0.05)
+    raise AssertionError(
+        f"campaign not settled within {timeout_s}s: "
+        f"state={view.get('state')} archives={view.get('archives')}")
+
+
+# --- units: manifest grammar and keys ---
+
+
+class TestManifest:
+    def test_idem_keys_are_deterministic_and_index_scoped(self):
+        """The key is a pure function of (campaign, index, path) — so
+        resubmission after restart regenerates it exactly (exactly-once
+        by construction) while a duplicated path gets a DISTINCT key per
+        entry (it must reach the result cache, not the idem dedupe)."""
+        assert (archive_idem_key("c1", 0, "/a.npz")
+                == archive_idem_key("c1", 0, "/a.npz"))
+        assert (archive_idem_key("c1", 0, "/a.npz")
+                != archive_idem_key("c1", 1, "/a.npz"))
+        assert (archive_idem_key("c1", 0, "/a.npz")
+                != archive_idem_key("c2", 0, "/a.npz"))
+
+    def test_compile_expands_globs_sorted_and_pins_keys(self, tmp_path):
+        for name in ("b.npz", "a.npz", "c.npz"):
+            (tmp_path / name).write_bytes(b"x")
+        camp = compile_manifest({"globs": [str(tmp_path / "*.npz")],
+                                 "tenant": "survey"})
+        paths = [e["path"] for e in camp["entries"]]
+        assert paths == sorted(paths) and len(paths) == 3
+        assert camp["tenant"] == "survey" and camp["state"] == "open"
+        assert all(e["idem_key"] == archive_idem_key(
+            camp["id"], e["index"], e["path"]) for e in camp["entries"])
+
+    def test_grammar_violations_are_loud(self, tmp_path):
+        with pytest.raises(ValueError, match="unknown manifest field"):
+            compile_manifest({"archives": ["/a"], "archvies": ["/b"]})
+        with pytest.raises(ValueError, match="names no archives"):
+            compile_manifest({"globs": [str(tmp_path / "none_*.npz")]})
+        with pytest.raises(ValueError, match="not in the campaign"):
+            compile_manifest({"archives": ["/a"],
+                              "overrides": {"/zzz": {"audit": True}}})
+        with pytest.raises(ValueError, match="unsupported override"):
+            compile_manifest({"archives": ["/a"],
+                              "overrides": {"/a": {"max_iter": 9}}})
+        with pytest.raises(ValueError, match="max_inflight"):
+            compile_manifest({"archives": ["/a"], "max_inflight": 0})
+
+
+# --- the tentpole e2e: kill a replica mid-campaign, exactly once ---
+
+
+def test_campaign_exactly_once_with_replica_kill(tmp_path):
+    """20 unique archives + 2 duplicates as one campaign over 2
+    replicas; the parked replica dies mid-run.  Every archive completes
+    exactly once fleet-wide (duplicates resolve born-terminal out of the
+    fleet result cache), masks are bit-identical to solo oracle cleans,
+    the campaign tenant rides failover end to end, and the cost showback
+    reconciles with the fleet cost plane's tenant row."""
+    paths = [_write(tmp_path, f"c{i:02d}.npz", seed=800 + i)
+             for i in range(20)]
+    entries = paths + [paths[0], paths[1]]          # 2 duplicates at the end
+    svc_a = _start_replica(tmp_path, "ca-a", deadline_s=3600.0,
+                           bucket_cap=8)            # parks accepted work
+    svc_b = _start_replica(tmp_path, "ca-b")
+    router = _start_router(svc_a, svc_b)
+    before_done = tracing.counters_snapshot().get("service_jobs_done", 0)
+    try:
+        row = _post(router, "/campaigns", {
+            "name": "kill-test", "tenant": "survey",
+            "archives": entries, "max_inflight": 4})
+        cid = row["id"]
+        assert row["state"] == "open"
+        assert row["archives"]["total"] == 22
+
+        # Let placements spread until the parked replica holds work,
+        # then crash it: the campaign's open placements on ca-a must
+        # fail over to ca-b under their pinned keys.
+        _drive(router, cid, timeout_s=60.0, until=lambda v: (
+            v["archives"]["placed"] + v["archives"]["done"] >= 3
+            and svc_a.scheduler.pending_count() >= 1))
+        svc_a.stop()
+
+        view = _drive(router, cid, timeout_s=180.0)
+        assert view["state"] == "done"
+        assert view["archives"]["done"] == 22
+        assert view["archives"]["error"] == 0
+        assert router.metrics.counter_total("fleet_failovers_total") >= 1
+
+        # Exactly once, fleet-wide: the shared in-process completion
+        # counter moved by the number of UNIQUE archives — the
+        # duplicates were served born-terminal by the result cache.
+        done_delta = tracing.counters_snapshot().get(
+            "service_jobs_done", 0) - before_done
+        assert done_delta == len(paths)
+        assert router.metrics.counter_total("fleet_cache_hits_total") >= 2
+
+        # Bit-identical masks vs the solo numpy oracle, duplicates
+        # included (they share the original's out_path).
+        by_index = {r["index"]: r for r in view["archive_records"]}
+        for idx, path in enumerate(entries):
+            got = by_index[idx]
+            assert got["state"] == "done"
+            np.testing.assert_array_equal(
+                NpzIO().load(got["out_path"]).weights,
+                _oracle_weights(path))
+
+        # The campaign tenant rode every hop — including the failover
+        # re-routes and the fleet-cache replies.
+        for rec in view["archive_records"]:
+            manifest = _get(router, f"/jobs/{rec['job_id']}")
+            assert manifest["tenant"] == "survey", rec
+
+        # QA roll-up covers every archive; no outliers in this corpus
+        # family (same synthesis parameters throughout).
+        assert view["rollup"]["jobs"] == 22
+        assert view["rollup"]["with_quality"] == 22
+        assert sum(view["rollup"]["termination"].values()) == 22
+
+        # Cost showback: real attributed seconds (the numpy oracle route
+        # books wall time under phases, not device_s), the duplicate
+        # cache hits, and reconciliation with the fleet cost plane's
+        # tenant row (same CostRecords, federated path).
+        cost = view["cost"]
+        assert cost["jobs_costed"] == 22
+        assert cost["phase_s"] > 0
+        assert cost["cache_hits"] == 2
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            router.poll_tick()
+            tenant_row = _get(router, "/fleet/costs")["tenants"].get(
+                "survey", {})
+            if abs(tenant_row.get("device_s", 0.0)
+                   - cost["device_s"]) <= max(0.05 * cost["device_s"],
+                                              0.05):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"campaign device_s {cost['device_s']} never reconciled "
+                f"with the fleet tenant row {tenant_row}")
+
+        # The campaign gauges follow the fold on the federated exposition.
+        metrics = _get_text(router, "/metrics")
+        assert "ict_campaign_open" in metrics
+        assert "ict_campaign_archives" in metrics
+        assert 'ict_campaign_device_seconds{campaign="%s"}' % cid in metrics
+        assert ('ict_campaign_cache_avoided_seconds{campaign="%s"}' % cid
+                in metrics)
+    finally:
+        router.stop()
+        svc_b.stop()
+        try:
+            svc_a.stop()
+        except Exception:  # noqa: BLE001 — already stopped mid-test
+            pass
+
+
+def _get_text(router, route):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}{route}", timeout=30) as resp:
+        return resp.read().decode()
+
+
+# --- satellite 3: restart-resume from the spool ---
+
+
+def test_campaign_restart_resume_is_exactly_once(tmp_path):
+    """Kill the router mid-campaign and restart it on the same spool:
+    terminal archives are NOT resubmitted, in-flight ones re-place under
+    their pinned keys (replica-side idempotency absorbs the duplicate
+    submission), and the finished campaign covers every archive with
+    oracle-identical masks."""
+    paths = [_write(tmp_path, f"r{i}.npz", seed=900 + i) for i in range(8)]
+    svc = _start_replica(tmp_path, "rr-a")
+    spool = str(tmp_path / "router_spool")
+    router = _start_router(svc, spool_dir=spool)
+    before_done = tracing.counters_snapshot().get("service_jobs_done", 0)
+    try:
+        cid = _post(router, "/campaigns", {
+            "tenant": "resume", "archives": paths,
+            "max_inflight": 3})["id"]
+        view = _drive(router, cid, timeout_s=60.0,
+                      until=lambda v: v["archives"]["done"] >= 3)
+        done_before = {r["index"] for r in view["archive_records"]
+                       if r["state"] == "done"}
+        jobs_before = {r["index"]: r["job_id"]
+                       for r in view["archive_records"]
+                       if r["state"] == "done"}
+        assert view["state"] == "open"
+    finally:
+        router.stop()
+
+    router2 = _start_router(svc, spool_dir=spool)
+    try:
+        view = _get(router2, f"/campaigns/{cid}")
+        by_index = {r["index"]: r for r in view["archive_records"]}
+        # Rehydration kept every terminal record terminal and demoted
+        # the in-flight ones to pending — nothing terminal re-runs.
+        for idx in done_before:
+            assert by_index[idx]["state"] == "done"
+        assert view["state"] == "open"
+
+        view = _drive(router2, cid, timeout_s=120.0)
+        assert view["state"] == "done"
+        assert view["archives"]["done"] == len(paths)
+        by_index = {r["index"]: r for r in view["archive_records"]}
+        # Terminal-before archives kept their original job ids — they
+        # were never resubmitted (attempts unchanged at 1).
+        for idx, jid in jobs_before.items():
+            assert by_index[idx]["job_id"] == jid
+            assert by_index[idx]["attempts"] == 1
+        # Exactly once ACROSS the restart: the replica-side completion
+        # ledger moved once per archive, resubmission dedupe included.
+        done_delta = tracing.counters_snapshot().get(
+            "service_jobs_done", 0) - before_done
+        assert done_delta == len(paths)
+        for idx, path in enumerate(paths):
+            np.testing.assert_array_equal(
+                NpzIO().load(by_index[idx]["out_path"]).weights,
+                _oracle_weights(path))
+        assert view["rollup"]["jobs"] == len(paths)
+    finally:
+        router2.stop()
+        svc.stop()
+
+
+# --- lifecycle: cancel, 400s, 404s ---
+
+
+def test_campaign_cancel_and_api_errors(tmp_path):
+    path = _write(tmp_path, "x.npz", seed=990)
+    svc = _start_replica(tmp_path, "cx-a")
+    router = _start_router(svc)
+    try:
+        # Grammar violations and bad JSON are 400s with the reason.
+        err = _post(router, "/campaigns", {"archvies": [path]},
+                    expect_error=True)
+        assert err.code == 400
+        assert _get(router, "/campaigns/nope", expect_error=True) == 404
+        err = _post(router, "/campaigns/nope/cancel", {},
+                    expect_error=True)
+        assert err.code == 404
+
+        # Cancel before the first tick: every archive is still pending,
+        # so the whole campaign settles cancelled with zero jobs run.
+        before = tracing.counters_snapshot().get("service_jobs_done", 0)
+        cid = _post(router, "/campaigns",
+                    {"archives": [path] * 3, "max_inflight": 1})["id"]
+        row = _post(router, f"/campaigns/{cid}/cancel", {})
+        assert row["state"] == "cancelled"
+        view = _drive(router, cid, timeout_s=30.0)
+        assert view["state"] == "cancelled"
+        assert view["archives"]["cancelled"] == 3
+        assert tracing.counters_snapshot().get(
+            "service_jobs_done", 0) == before
+        # The campaign shows up in the list and the health summary.
+        assert any(c["id"] == cid
+                   for c in _get(router, "/campaigns")["campaigns"])
+        assert _get(router, "/healthz")["campaigns"]["open"] == 0
+    finally:
+        router.stop()
+        svc.stop()
+
+
+# --- the CLI follow client ---
+
+
+def test_campaign_cli_follows_to_the_verdict(tmp_path, capsys):
+    """``ict-clean campaign MANIFEST`` submits, follows, and exits with
+    the campaign verdict: 0 on done-clean, 1 when any archive failed."""
+    from iterative_cleaner_tpu.campaign.cli import campaign_main
+
+    paths = [_write(tmp_path, f"m{i}.npz", seed=950 + i) for i in range(2)]
+    svc = _start_replica(tmp_path, "cli-a")
+    # The CLI needs a LIVE poll loop (no test-driven ticks here).
+    router = _start_router(svc, poll_interval_s=0.05)
+    url = f"http://127.0.0.1:{router.port}"
+    try:
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps({"archives": paths,
+                                    "tenant": "cli"}))
+        rc = campaign_main([str(good), "--router", url,
+                            "--poll_s", "0.05", "--json"])
+        assert rc == 0
+        view = json.loads(capsys.readouterr().out.strip())
+        assert view["state"] == "done"
+        assert view["cost"]["phase_s"] > 0
+
+        # fleet_top renders the CAMPAIGNS section off /healthz.
+        import importlib.util
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "fleet_top", os.path.join(repo, "tools", "fleet_top.py"))
+        fleet_top = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(fleet_top)
+        assert fleet_top.main(["--router", url]) == 0
+        table = capsys.readouterr().out
+        assert "CAMPAIGNS" in table
+        assert view["id"][:22] in table
+        assert "cli" in table
+
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps(
+            {"archives": [paths[0], str(tmp_path / "missing.npz")]}))
+        rc = campaign_main([str(bad), "--router", url,
+                            "--poll_s", "0.05", "-q"])
+        assert rc == 1
+
+        # Unreadable manifest and unreachable router are their own exits.
+        assert campaign_main([str(tmp_path / "nope.json"),
+                              "--router", url]) == 2
+    finally:
+        router.stop()
+        svc.stop()
+
+
+# --- satellite 1: size-capped event-sink rotation ---
+
+
+def test_event_log_rotation_is_size_capped(tmp_path, monkeypatch):
+    """ICT_EVENT_LOG_MAX_MB rotates the sink to <path>.1 and keeps
+    appending — bounded at ~2x the cap, counted, and the emit path never
+    raises."""
+    sink = tmp_path / "events.jsonl"
+    monkeypatch.setenv("ICT_EVENT_LOG_MAX_MB", "0.002")   # ~2 KB cap
+    before = events.rotations()
+    events.configure(str(sink))
+    try:
+        for i in range(200):
+            events.emit("rotation_probe", seq=i, pad="x" * 64)
+        assert events.rotations() > before
+        assert sink.exists() and (tmp_path / "events.jsonl.1").exists()
+        cap = int(0.002 * (1 << 20))
+        assert sink.stat().st_size <= cap + 256
+        assert (tmp_path / "events.jsonl.1").stat().st_size <= cap + 256
+        # Every surviving line is intact JSON — rotation never tears a
+        # record.
+        for line in sink.read_text().splitlines():
+            json.loads(line)
+        # The cap off (0) stops rotation cold.
+        monkeypatch.setenv("ICT_EVENT_LOG_MAX_MB", "0")
+        n = events.rotations()
+        for i in range(200):
+            events.emit("rotation_probe_off", seq=i, pad="x" * 64)
+        assert events.rotations() == n
+        assert sink.stat().st_size > cap
+    finally:
+        events.configure(None)

@@ -8,9 +8,10 @@ human-readable snapshot: per-replica state (alive/draining/dead,
 straggler and autoscale-managed flags, queue depths, utilization,
 service rate, dispatch p50), per-bucket backlog/demand/drain-ETA rows
 (with roofline attainment), the fleet totals, the autoscaler state, a
-TENANTS showback section off the cost plane (device-seconds, jobs,
-cache savings, budget burn), and a FIRING ALERTS section off the
-alerting plane.  ``--json`` prints the same snapshot as ONE JSON line
+CAMPAIGNS section off the survey orchestrator (per-campaign archive
+progress and device-seconds), a TENANTS showback section off the cost
+plane (device-seconds, jobs, cache savings, budget burn), and a FIRING
+ALERTS section off the alerting plane.  ``--json`` prints the same snapshot as ONE JSON line
 for scripting (the bench.py one-line contract); ``--watch N``
 re-renders every N seconds until interrupted (one JSON line per
 refresh in ``--json`` mode).  Read-only: five GETs, no mutation, safe
@@ -109,6 +110,7 @@ def collect(base: str, timeout_s: float = 10.0) -> dict:
         "cache_hit_rates": {b: cache_hit_rate(counts)
                             for b, counts in cache_counts.items()},
         "fleet_cache": health.get("result_cache") or {},
+        "campaigns": health.get("campaigns") or {},
     }
 
 
@@ -204,6 +206,7 @@ def render(snap: dict) -> str:
                 f"{_fmt_num(co_p50s.get(bucket)):>7} "
                 f"{_fmt_num(round(rate * 100, 1)) if rate is not None else '-':>6} "
                 f"{_fmt_num(crec.get('attainment')):>7}")
+    lines += render_campaigns(snap.get("campaigns") or {})
     lines += render_tenants(snap.get("costs") or {})
     fleet = capacity.get("fleet", {})
     if fleet:
@@ -234,6 +237,37 @@ def render(snap: dict) -> str:
         lines += ["autoscale off"]
     lines += render_alerts(snap.get("alerts") or {})
     return "\n".join(lines)
+
+
+def render_campaigns(campaigns: dict) -> list[str]:
+    """The CAMPAIGNS section (from ``/healthz``, the orchestrator's
+    summary): one row per campaign — state, tenant, archive progress,
+    errors, and the attributed device-seconds from the showback fold.
+    The header aggregates archive states across every OPEN campaign so
+    survey progress reads at a glance."""
+    rows = campaigns.get("campaigns") or []
+    if not rows:
+        return []
+    states = campaigns.get("archives") or {}
+    agg = "  ".join(f"{s}={_fmt_num(states[s])}"
+                    for s in ("pending", "placed", "done", "error",
+                              "cancelled") if states.get(s))
+    lines = ["", f"CAMPAIGNS  (open={campaigns.get('open', 0)}"
+                 + (f"  {agg}" if agg else "") + ")",
+             f"{'CAMPAIGN':<22} {'NAME':<16} {'STATE':<10} {'TENANT':<12} "
+             f"{'DONE/TOT':>9} {'ERR':>4} {'DEVICE_S':>9}"]
+    for row in rows:
+        arch = row.get("archives") or {}
+        lines.append(
+            f"{str(row.get('id', '?'))[:22]:<22} "
+            f"{str(row.get('name', '?'))[:16]:<16} "
+            f"{row.get('state', '?'):<10} "
+            f"{str(row.get('tenant', '?'))[:12]:<12} "
+            f"{_fmt_num(arch.get('done', 0))}/"
+            f"{_fmt_num(arch.get('total', 0)):<4} "
+            f"{_fmt_num(arch.get('error', 0)):>4} "
+            f"{_fmt_num(row.get('device_s')):>9}")
+    return lines
 
 
 def render_tenants(costs: dict) -> list[str]:
