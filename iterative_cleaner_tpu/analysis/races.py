@@ -58,6 +58,10 @@ RACE_SCOPE_PREFIXES = (
     # ISSUE 16: the campaign orchestrator's tables and the spool store —
     # its lock orders after the router's (campaign/orchestrator.py).
     "iterative_cleaner_tpu/campaign/",
+    # ISSUE 17: the proving ground — the soak driver and chaos drills
+    # are single-threaded by design (they DRIVE the router's tick), so
+    # their state is annotated thread-confined rather than locked.
+    "iterative_cleaner_tpu/proving/",
 )
 
 LOCK_FACTORIES = {"Lock", "RLock"}

@@ -231,6 +231,13 @@ def main(argv: list[str] | None = None) -> int:
         from iterative_cleaner_tpu.campaign.cli import campaign_main
 
         return campaign_main(argv[1:])
+    if argv and argv[0] == "prove" and not os.path.isfile("prove"):
+        # The proving ground: scenario mix + chaos drills against an
+        # in-process fleet, one JSON verdict line (docs/PROVING.md);
+        # same literal-token dispatch rule as ``serve``.
+        from iterative_cleaner_tpu.proving.soak import prove_main
+
+        return prove_main(argv[1:])
     if argv and argv[0] == "serve-fleet" and not os.path.isfile("serve-fleet"):
         # The fleet router in front of N daemon replicas (docs/SERVING.md
         # "Fleet"); same literal-token dispatch rule as ``serve``, and

@@ -210,6 +210,22 @@ def process_archive(
     from iterative_cleaner_tpu.obs import events
     from iterative_cleaner_tpu.obs.tracing import profile_trace
 
+    if events.active():
+        # CLI entry point of the replay contract (proving/traces.py):
+        # job_submitted must carry tenant / shape bucket / config salt /
+        # arrival ts wherever work enters, so an event log recorded from
+        # a batch CLI run replays the same as one from the daemon.  The
+        # bucket grammar is the scheduler's NSUBxNCHANxNBIN (data is
+        # (nsub, npol, nchan, nbin) — pol is not a bucketing axis).
+        from iterative_cleaner_tpu.ingest import cas as _cas
+        from iterative_cleaner_tpu.service.scheduler import bucket_label
+        s = archive.data.shape
+        shape_hint = [int(s[0]), int(s[2]), int(s[3])]
+        events.emit("job_submitted", path=path, entry="cli",
+                    replica_id="", job_id="", tenant="", idem_key="",
+                    cache_salt=_cas.cache_salt(cfg), shape=shape_hint,
+                    bucket=bucket_label(shape_hint))
+
     cleaner = SurgicalCleaner(cfg)
     with profile_trace(cfg.trace_dir), \
             events.span("clean_archive", path=path,

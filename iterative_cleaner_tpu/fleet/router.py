@@ -1364,11 +1364,18 @@ class FleetRouter:
         self.traces.record(trace_id, "fleet_done", job_id=job_id,
                            served_by="fleet-cache")
         if events.active():
+            # path/idem_key/shape ride along so a cache-served submission
+            # (which never reaches a replica's job_submitted) is still
+            # replayable from the event log (proving/traces.py).
             events.emit("fleet_cache_hit", trace_id=trace_id,
                         job_id=job_id,
                         origin_job_id=origin.get("job_id", ""),
                         replica_id=origin.get("replica_id", ""),
-                        tenant=tenant)
+                        tenant=tenant,
+                        path=str(payload.get("path", "") or ""),
+                        idem_key=key,
+                        shape=[int(v) for v in shape],
+                        cache_salt=salt)
         # Deliberately NOT counted in fleet_jobs_completed_total: that
         # counter is the exactly-once ledger of placements the fleet
         # actually ran, and the smoke/tests pin it against replica-side
@@ -1441,7 +1448,8 @@ class FleetRouter:
             events.emit("fleet_placement", trace_id=trace_id,
                         job_id=placement.job_id,
                         replica_id=rep.replica_id, tenant=tenant,
-                        bucket=self._bucket_of(payload))
+                        bucket=self._bucket_of(payload),
+                        idem_key=key)
         return {**body, "tenant": tenant, "router_id": self.router_id}
 
     def _await_grant(self, tenant: str) -> None:

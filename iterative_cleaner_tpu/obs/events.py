@@ -82,6 +82,18 @@ def rotations() -> int:
         return _rotations
 
 
+def sink_degraded() -> bool:
+    """True while the sink sits in its post-failure drop window (a write
+    failed — full disk, yanked directory — and events are being dropped
+    until the ``SINK_RETRY_S`` backoff expires).  The proving ground's
+    full-disk chaos drill exports this as the ``ict_prove_event_sink_``
+    ``degraded`` gauge so the fault is alertable instead of a lone stderr
+    warning; :func:`configure` (pointing at a healthy path) clears it
+    immediately."""
+    with _lock:
+        return bool(_retry_at) and time.monotonic() < _retry_at
+
+
 def new_trace_id() -> str:
     return uuid.uuid4().hex[:16]
 

@@ -305,6 +305,13 @@ class _Handler(BaseHTTPRequestHandler):
             audit = bool(body.get("audit", False))
             idem_key = str(body.get("idempotency_key", "") or "")
             tenant = str(body.get("tenant", "") or "")
+            shape = body.get("shape")
+            if shape is not None:
+                # Same optional grammar the fleet router accepts: the
+                # declared [nsub, nchan, nbin] hint rides into the
+                # job_submitted event so a recorded trace replays with
+                # its original bucket (proving/traces.py).
+                shape = [int(v) for v in shape]
         # TypeError covers valid-JSON non-dict bodies ('[]', '5', 'null'):
         # the client gets a 400, not a dropped socket.
         except (ValueError, KeyError, TypeError) as exc:
@@ -325,7 +332,8 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             job = service.submit(str(path), profile=profile, audit=audit,
                                  idempotency_key=idem_key,
-                                 trace_id=trace_id, tenant=tenant)
+                                 trace_id=trace_id, tenant=tenant,
+                                 shape=shape)
         except ServiceBusy as exc:
             self._reply(503, {"error": str(exc)}, headers={"Retry-After": "5"})
             return

@@ -41,7 +41,10 @@ from iterative_cleaner_tpu.service.context import (  # noqa: F401 — ServiceBus
     ServiceBusy,                     # API layer and embedders import it here
 )
 from iterative_cleaner_tpu.service.jobs import TERMINAL, Job
-from iterative_cleaner_tpu.service.scheduler import ShapeBucketScheduler
+from iterative_cleaner_tpu.service.scheduler import (
+    ShapeBucketScheduler,
+    bucket_label,
+)
 from iterative_cleaner_tpu.service.worker import DispatchWorker
 
 _STOP = object()
@@ -382,7 +385,8 @@ class CleaningService:
 
     def submit(self, path: str, profile: bool = False,
                audit: bool = False, idempotency_key: str = "",
-               trace_id: str = "", tenant: str = "") -> Job:
+               trace_id: str = "", tenant: str = "",
+               shape: list | tuple | None = None) -> Job:
         # A draining replica accepts no NEW work (503; the router reads the
         # same flag off /healthz and stops placing here) — already-accepted
         # jobs keep running to completion (docs/SERVING.md "Fleet").
@@ -432,9 +436,23 @@ class CleaningService:
             raise
         tracing.count("service_jobs_submitted")
         if events.active():
+            # The replay contract (proving/traces.py): this event must
+            # carry everything a re-issue needs — arrival ts (the line's
+            # own "ts"), tenant, the idempotency key, the replica's
+            # config salt, and the declared shape/bucket hint — at every
+            # entry point (POST /jobs directly, via the router, campaign
+            # orchestrator submissions all funnel through here).
+            shape_hint = ([int(v) for v in shape]
+                          if shape is not None and len(shape) == 3 else [])
             events.emit("job_submitted", trace_id=job.trace_id,
                         job_id=job.id, path=path,
-                        replica_id=self.replica_id)
+                        replica_id=self.replica_id,
+                        entry="service", tenant=job.tenant,
+                        idem_key=job.idem_key,
+                        cache_salt=self.ctx.cache_salt,
+                        shape=shape_hint,
+                        bucket=(bucket_label(shape_hint)
+                                if shape_hint else ""))
         self._load_q.put(job)
         return job
 

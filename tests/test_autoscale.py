@@ -430,9 +430,15 @@ def test_backlog_scale_up_advise_then_act(tmp_path):
             assert router.metrics.counter_value(
                 "fleet_scale_events_total",
                 {"direction": "up", "reason": "backlog"}) >= 1
-            reasons = [b.get("reason") for b in fleet_obs.list_incidents(
-                router.incident_dir)]
-            assert "scale_up" in reasons
+            # The decision bundle lands at the END of the spawn thread
+            # (_execute_scale_up: registry join -> poll_once -> bundle),
+            # a beat after the registry shows 2 — wait for it, don't
+            # sample it.
+            assert _tick_until(
+                router,
+                lambda: "scale_up" in [
+                    b.get("reason") for b in fleet_obs.list_incidents(
+                        router.incident_dir)])
             # the decision is reconstructible from the exposition alone:
             # capacity gauges + the scale-event counter, strict grammar
             text = urllib.request.urlopen(

@@ -313,6 +313,9 @@ class TestPerfGateIngestContract:
         # The cost-accounting block (ISSUE 15): a bare {} would
         # (correctly) fail the "no attainment table" check.
         payload["costs"] = {"attainment": {}}
+        # The proving-ground fleet block (ISSUE 17): a bare {} would
+        # (correctly) fail the "no scaling_ratio" check.
+        payload["fleet"] = {"scaling_ratio": 1.0}
         payload["donation_ledger"] = dict(base["donation_ledger"])
         assert pg.compare(payload, base, 3.0, 1.15) == []
 

@@ -64,6 +64,13 @@ CONDITIONAL_FAMILIES = {
     "ict_rfi_zaps_attributed_total",   # needs ICT_FORENSICS=1 timelines
     "ict_fleet_replica_bucket_queue_depth",  # needs cubes PARKED at the
                                        # instant of a health poll
+    # proving-ground gauges: only published while an ``ict-clean prove``
+    # soak is driving the router (docs/PROVING.md)
+    "ict_prove_scenario_jobs",
+    "ict_prove_faults_injected",
+    "ict_prove_faults_healed",
+    "ict_prove_soak_verdict",
+    "ict_prove_event_sink_degraded",
 }
 
 #: ``ict_``-prefixed doc tokens that are tools/paths, not metric

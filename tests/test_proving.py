@@ -1,0 +1,266 @@
+"""The proving ground (ISSUE 17): trace grammar, seeded scenarios, chaos
+drills, and the ``ict-clean prove`` verdict contract.
+
+Offline half: the trace file grammar (round-trip + every rejection in
+``load_trace``), seeded scenario determinism (same seed -> same
+``mix_digest``), the metric-name grammar for the ``ict_prove_*``
+families, and the event-sink degradation flag.
+
+Live half: a hermetic 2-replica ``ProvingFleet`` per test — the
+record->replay dedupe loop (replaying a served window costs ZERO replica
+work), the duplicate-storm born-terminal CAS observable, every chaos
+drill's closed loop (inject -> alert -> heal -> resolve -> books
+balance), and the soak verdict rc contract (a budget that cannot fund
+the proof is a FAIL, not a vacuous pass).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import tempfile
+import time
+
+import pytest
+
+from iterative_cleaner_tpu.obs import events
+from iterative_cleaner_tpu.proving import chaos, scenarios, traces
+from iterative_cleaner_tpu.proving.soak import ProvingFleet, SoakConfig, run_soak
+
+
+# --------------------------------------------------------------------------
+# Trace grammar (offline)
+# --------------------------------------------------------------------------
+
+
+def _event_line(fh, **rec):
+    fh.write(json.dumps(rec) + "\n")
+
+
+def test_trace_record_round_trip(tmp_path):
+    """job_submitted + fleet_cache_hit events become a replayable trace:
+    dedupe by idempotency key (failover's second job_submitted is the
+    same arrival), anonymous CLI arrivals all kept, order by ts, and
+    every field survives load_trace."""
+    log = str(tmp_path / "events.jsonl")
+    with open(log, "w") as fh:
+        _event_line(fh, event="job_submitted", ts=100.0, path="/a.npz",
+                    tenant="t1", idem_key="k1", shape=[4, 16, 64],
+                    bucket="4x16x64", trace_id="tr1", entry="service")
+        # Failover re-submission: same key, later ts -> ONE trace entry.
+        _event_line(fh, event="job_submitted", ts=101.0, path="/a.npz",
+                    tenant="t1", idem_key="k1", shape=[4, 16, 64])
+        _event_line(fh, event="fleet_cache_hit", ts=102.5, path="/b.npz",
+                    idem_key="k2", shape=[8, 32, 128], cache_salt="s1")
+        _event_line(fh, event="job_submitted", ts=101.5, path="/c.npz",
+                    entry="cli")     # anon: no key, kept as-is
+        _event_line(fh, event="job_done", ts=103.0, path="/a.npz")
+        fh.write("{torn line not json\n")
+    out = str(tmp_path / "prove.trace.jsonl")
+    assert traces.record_trace(log, out) == 3
+    entries = traces.load_trace(out)
+    assert [e.path for e in entries] == ["/a.npz", "/c.npz", "/b.npz"]
+    first = entries[0]
+    assert (first.tenant, first.idem_key, first.shape, first.bucket,
+            first.trace_id, first.entry) == (
+        "t1", "k1", (4, 16, 64), "4x16x64", "tr1", "service")
+    assert first.t == 0.0                    # t is relative to t0
+    assert entries[1].entry == "cli" and entries[1].idem_key == ""
+    cached = entries[2]
+    assert (cached.entry, cached.salt) == ("cache", "s1")
+    assert cached.t == pytest.approx(2.5)
+    # Replay keys: original when recorded, deterministic otherwise.
+    assert traces.replay_key(first, 0) == "k1"
+    assert traces.replay_key(entries[1], 1) == "replay:anon:1"
+
+
+@pytest.mark.parametrize("lines,msg", [
+    ([], "empty"),
+    (["not json"], "not JSON"),
+    (['{"kind": "other", "version": 1}'], "kind"),
+    (['{"kind": "ict-trace", "version": 99}'], "version"),
+    (['{"kind": "ict-trace", "version": 1}', '{"t": 0.0}'], "path"),
+    (['{"kind": "ict-trace", "version": 1}',
+      '{"t": -1.0, "path": "/a"}'], "'t'"),
+    (['{"kind": "ict-trace", "version": 1}',
+      '{"t": 5.0, "path": "/a"}',
+      '{"t": 1.0, "path": "/b"}'], "out of order"),
+    (['{"kind": "ict-trace", "version": 1}',
+      '{"t": 0.0, "path": "/a", "shape": [4, 0, 64]}'], "shape"),
+    (['{"kind": "ict-trace", "version": 1}',
+      '{"t": 0.0, "path": "/a", "entry": "carrier-pigeon"}'], "entry"),
+    (['{"kind": "ict-trace", "version": 1, "entries": 5}',
+      '{"t": 0.0, "path": "/a"}'], "declares"),
+])
+def test_load_trace_rejects(tmp_path, lines, msg):
+    p = str(tmp_path / "bad.trace.jsonl")
+    with open(p, "w") as fh:
+        fh.write("\n".join(lines) + ("\n" if lines else ""))
+    with pytest.raises(ValueError, match=msg):
+        traces.load_trace(p)
+
+
+# --------------------------------------------------------------------------
+# Scenario catalog (offline)
+# --------------------------------------------------------------------------
+
+
+def test_build_mix_deterministic(tmp_path):
+    """Same (seed, mix) -> identical submission stream AND identical
+    content digest; a different seed changes the bytes."""
+    a = scenarios.build_mix(str(tmp_path), 7, scenarios.SMOKE_MIX)
+    b = scenarios.build_mix(str(tmp_path), 7, scenarios.SMOKE_MIX)
+    assert [(s.scenario, s.idem_key, s.path) for s in a] == \
+           [(s.scenario, s.idem_key, s.path) for s in b]
+    assert scenarios.mix_digest(a) == scenarios.mix_digest(b)
+    other = scenarios.build_mix(str(tmp_path), 8, scenarios.SMOKE_MIX)
+    assert scenarios.mix_digest(other) != scenarios.mix_digest(a)
+
+
+def test_build_mix_rejects_unknown_scenario(tmp_path):
+    with pytest.raises(ValueError, match="unknown scenario"):
+        scenarios.build_mix(str(tmp_path), 0, {"meteor_strike": 1})
+
+
+def test_duplicate_storm_shares_one_cube(tmp_path):
+    subs = scenarios.gen_duplicate_storm(str(tmp_path), 3, 4)
+    assert len({s.path for s in subs}) == 1       # one cube on disk
+    assert len({s.idem_key for s in subs}) == 4   # distinct keys
+
+
+def test_prove_metric_names_fit_grammar():
+    """Every family the soak publishes (and both alert rule names) fit
+    the exposition grammar ICT005 enforces."""
+    grammar = re.compile(r"^[a-z][a-z0-9_]*$")
+    for fam in ("ict_prove_scenario_jobs", "ict_prove_faults_injected",
+                "ict_prove_faults_healed", "ict_prove_soak_verdict",
+                "ict_prove_event_sink_degraded"):
+        assert grammar.match(fam), fam
+    for rule in (chaos.RULE_REPLICA_DEAD, chaos.RULE_SINK_DEGRADED):
+        assert grammar.match(rule), rule
+
+
+def test_event_sink_degraded_flag(tmp_path):
+    """An unwritable sink path flips sink_degraded() on the first emit;
+    a good sink clears it on the next."""
+    prior = events.configured_sink()
+    blocker = tmp_path / "blocker"
+    blocker.write_text("a regular file, not a directory")
+    try:
+        events.configure(str(blocker / "events.jsonl"))   # ENOTDIR
+        events.emit("prove_probe")
+        assert events.sink_degraded()
+        events.configure(str(tmp_path / "events.jsonl"))
+        events.emit("prove_probe")
+        assert not events.sink_degraded()
+    finally:
+        events.configure(prior)
+
+
+# --------------------------------------------------------------------------
+# Live fleet: replay dedupe, storm CAS, chaos drills, verdict contract
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    f = ProvingFleet(str(tmp_path), seed=12345)
+    yield f
+    f.close()
+
+
+def test_record_replay_costs_zero_replica_work(fleet, tmp_path):
+    """Serve a small window, record its trace, replay at 1000x: every
+    replayed arrival must dedupe under its original idempotency key —
+    the dedupe counter moves one-for-one and the replica completion
+    counter does not move at all."""
+    subs = scenarios.gen_small_flood(fleet.workdir, 12346, 3)
+    replies = [fleet.submit(s) for s in subs]
+    fleet.await_terminal([r["id"] for r in replies])
+    trace_path = str(tmp_path / "window.trace.jsonl")
+    recorded = traces.record_trace(fleet.telemetry, trace_path)
+    assert recorded == 3
+    entries = traces.load_trace(trace_path)
+    assert all(e.tenant and e.idem_key and e.shape == (4, 16, 64)
+               for e in entries)
+    done0 = fleet.jobs_done()
+    dedup0 = fleet.router.metrics.counter_total(
+        "fleet_deduped_submissions_total")
+    report = traces.replay_trace(entries, fleet.base_url,
+                                 compression=1000.0)
+    assert report["errors"] == []
+    assert report["submitted"] == 3
+    dedup_delta = fleet.router.metrics.counter_total(
+        "fleet_deduped_submissions_total") - dedup0
+    assert dedup_delta == 3
+    assert fleet.jobs_done() == done0
+
+
+def test_duplicate_storm_echoes_born_terminal(fleet):
+    """The first storm copy runs; once the scrape learns its result the
+    echoes are served from the fleet CAS born-terminal — no new replica
+    completions."""
+    from iterative_cleaner_tpu.fleet import cache as fleet_cache
+    from iterative_cleaner_tpu.ingest import cas
+
+    subs = scenarios.gen_duplicate_storm(fleet.workdir, 12399, 3)
+    first = fleet.submit(subs[0])
+    fleet.await_terminal([first["id"]])
+    digest = cas.file_digest(subs[0].path)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        salt = fleet_cache.unanimous_salt(fleet.router.registry.snapshot())
+        if salt and fleet.router.result_index.lookup(digest, salt):
+            break
+        fleet.tick()
+        time.sleep(0.05)
+    else:
+        pytest.fail("result index never learned the storm cube")
+    done0 = fleet.jobs_done()
+    for echo in subs[1:]:
+        reply = fleet.submit(echo)
+        assert reply.get("served_by") == "fleet-cache"
+        assert reply.get("state") == "done"
+    assert fleet.jobs_done() == done0
+
+
+@pytest.mark.parametrize("name", sorted(chaos.DRILLS))
+def test_chaos_drill_closes_loop(fleet, name):
+    """Each drill's full loop: inject -> alert fires -> heal -> alert
+    resolves -> masks bit-identical -> exactly-once ledger -> cost
+    conservation."""
+    report = chaos.DRILLS[name](fleet)
+    assert report.ok, report.to_json()
+    assert report.fault == name
+
+
+def test_soak_zero_budget_is_a_fail(tmp_path, capsys):
+    """A budget that cannot fund the proof is rc 1 with an explanatory
+    verdict line — never a vacuous pass."""
+    rc = run_soak(SoakConfig(smoke=True, job_budget=0,
+                             workdir=str(tmp_path), quiet=True))
+    assert rc == 1
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1                      # the one-line contract
+    verdict = json.loads(out[0])
+    assert verdict["prove"] == "fail"
+    assert "budget" in verdict["error"]
+    assert verdict["rc"] == 1
+
+
+@pytest.mark.slow
+def test_soak_smoke_passes(tmp_path, capsys):
+    """The CI lane end to end: one scenario tick + replay lane + one
+    drill -> rc 0 and a verdict whose triad holds."""
+    rc = run_soak(SoakConfig(smoke=True, seed=5, workdir=str(tmp_path),
+                             quiet=True))
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    verdict = json.loads(out[0])
+    assert rc == 0, verdict
+    assert verdict["prove"] == "pass"
+    assert all(verdict["triad"].values())
+    assert verdict["jobs"]["lost"] == 0
+    assert verdict["storm_cas_ok"]
+    assert verdict["replay"]["ok"]
+    assert all(d["ok"] for d in verdict["drills"])

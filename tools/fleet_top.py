@@ -10,8 +10,10 @@ service rate, dispatch p50), per-bucket backlog/demand/drain-ETA rows
 (with roofline attainment), the fleet totals, the autoscaler state, a
 CAMPAIGNS section off the survey orchestrator (per-campaign archive
 progress and device-seconds), a TENANTS showback section off the cost
-plane (device-seconds, jobs, cache savings, budget burn), and a FIRING
-ALERTS section off the alerting plane.  ``--json`` prints the same snapshot as ONE JSON line
+plane (device-seconds, jobs, cache savings, budget burn), a SOAK
+section off the proving ground's ``ict_prove_*`` gauges when an
+``ict-clean prove`` soak is driving the router (docs/PROVING.md), and a
+FIRING ALERTS section off the alerting plane.  ``--json`` prints the same snapshot as ONE JSON line
 for scripting (the bench.py one-line contract); ``--watch N``
 re-renders every N seconds until interrupted (one JSON line per
 refresh in ``--json`` mode).  Read-only: five GETs, no mutation, safe
@@ -70,6 +72,14 @@ def collect(base: str, timeout_s: float = 10.0) -> dict:
     # merged replica-side result-cache counters).
     co_sizes: dict[str, dict[int, float]] = {}
     cache_counts: dict[str, dict[str, float]] = {}
+    # The proving-ground gauges (only present while an ``ict-clean
+    # prove`` soak is driving this router — docs/PROVING.md): scenario
+    # job counts, chaos-drill inject/heal tallies, and the running
+    # verdict / sink-degraded flags.
+    soak_scenarios: dict[str, float] = {}
+    soak_faults: dict[str, dict[str, float]] = {}
+    soak_verdict: float | None = None
+    soak_sink_degraded: float | None = None
     try:
         fams = obs_metrics.parse_exposition(
             _get_text(base, "/fleet/metrics", timeout_s))
@@ -96,6 +106,20 @@ def collect(base: str, timeout_s: float = 10.0) -> dict:
                 bucket = cache_counts.setdefault(d["shape_bucket"], {})
                 bucket[d["outcome"]] = (bucket.get(d["outcome"], 0.0)
                                         + obs_metrics.sample_value(raw))
+            elif fam.name == "ict_prove_scenario_jobs" and "scenario" in d:
+                soak_scenarios[d["scenario"]] = obs_metrics.sample_value(raw)
+            elif (fam.name in ("ict_prove_faults_injected",
+                               "ict_prove_faults_healed")
+                    and "fault" in d):
+                rec = soak_faults.setdefault(d["fault"],
+                                             {"injected": 0.0, "healed": 0.0})
+                which = ("injected" if fam.name.endswith("injected")
+                         else "healed")
+                rec[which] = obs_metrics.sample_value(raw)
+            elif fam.name == "ict_prove_soak_verdict":
+                soak_verdict = obs_metrics.sample_value(raw)
+            elif fam.name == "ict_prove_event_sink_degraded":
+                soak_sink_degraded = obs_metrics.sample_value(raw)
     return {
         "router": base,
         "router_id": health.get("router_id"),
@@ -111,6 +135,11 @@ def collect(base: str, timeout_s: float = 10.0) -> dict:
                             for b, counts in cache_counts.items()},
         "fleet_cache": health.get("result_cache") or {},
         "campaigns": health.get("campaigns") or {},
+        "soak": ({"scenarios": soak_scenarios, "faults": soak_faults,
+                  "verdict": soak_verdict,
+                  "sink_degraded": soak_sink_degraded}
+                 if (soak_scenarios or soak_faults
+                     or soak_verdict is not None) else {}),
     }
 
 
@@ -208,6 +237,7 @@ def render(snap: dict) -> str:
                 f"{_fmt_num(crec.get('attainment')):>7}")
     lines += render_campaigns(snap.get("campaigns") or {})
     lines += render_tenants(snap.get("costs") or {})
+    lines += render_soak(snap.get("soak") or {})
     fleet = capacity.get("fleet", {})
     if fleet:
         fc = snap.get("fleet_cache") or {}
@@ -295,6 +325,37 @@ def render_tenants(costs: dict) -> list[str]:
             f"{_fmt_num(rec.get('jobs')):>6} "
             f"{_fmt_num(rec.get('avoided_device_s')):>8} "
             f"{_fmt_num(pct) if pct is not None else '-':>8}")
+    return lines
+
+
+def render_soak(soak: dict) -> list[str]:
+    """The SOAK section (from the ``ict_prove_*`` gauges a running
+    ``ict-clean prove`` soak publishes on the router — docs/PROVING.md):
+    per-scenario job counts, per-fault inject/heal tallies, the running
+    verdict (running/pass/fail) and the telemetry-sink health.  Empty
+    (section absent) when no soak has touched this router."""
+    if not soak:
+        return []
+    verdict = soak.get("verdict")
+    verdict_s = {0.0: "running", 1.0: "pass", 2.0: "fail"}.get(
+        verdict, _fmt_num(verdict))
+    sink = soak.get("sink_degraded")
+    head = (f"SOAK  (verdict={verdict_s}"
+            + (f"  sink={'degraded' if sink else 'ok'}"
+               if sink is not None else "") + ")")
+    lines = ["", head]
+    scenarios = soak.get("scenarios") or {}
+    if scenarios:
+        lines.append(f"{'SCENARIO':<20} {'JOBS':>6}")
+        for name in sorted(scenarios):
+            lines.append(f"{name:<20} {_fmt_num(scenarios[name]):>6}")
+    faults = soak.get("faults") or {}
+    if faults:
+        lines.append(f"{'FAULT':<22} {'INJECTED':>9} {'HEALED':>7}")
+        for name in sorted(faults):
+            rec = faults[name]
+            lines.append(f"{name:<22} {_fmt_num(rec.get('injected')):>9} "
+                         f"{_fmt_num(rec.get('healed')):>7}")
     return lines
 
 

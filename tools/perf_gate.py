@@ -142,7 +142,7 @@ STATIC_KEYS = ("step_dense_bytes_cubes", "step_incremental_bytes_cubes",
 #: throughput + content-cache round-trip, parity-flagged).
 REQUIRED_KEYS = ("metric", "value", "unit", "vs_baseline",
                  "compile_accounting", "memory", "audit", "ingest",
-                 "coalesce", "costs")
+                 "coalesce", "costs", "fleet")
 
 #: The tentpole's acceptance bar: the baseline must have demonstrated
 #: >= 50% upload/compute overlap for the floor check to arm at all.
@@ -184,6 +184,20 @@ COALESCE_FLOOR = 2.0
 #: launch amortization under 1.3 (the ratio is intra-run; machine speed
 #: cancels).
 COALESCE_COLLAPSE = 1.3
+
+#: Fleet-layer ratchet (ISSUE 17, the same collapse-floor pattern): the
+#: baseline must have demonstrated that two in-process replicas behind
+#: the router at least MATCH one replica driven directly (>= 1.0 warm
+#: jobs/s ratio) for the check to arm — on a loaded shared CPU runner
+#: two numpy/jax workers contend for the same cores, so parity, not 2x,
+#: is the honest floor...
+FLEET_FLOOR = 1.0
+#: ...and once armed it fails only on a collapse below this: a
+#: placement-path regression that serializes the fleet behind the router
+#: (every job waiting a full poll interval, or the WFQ grant pump
+#: stalling) reads well under 0.4, while runner load alone cannot —
+#: both arms of the intra-run ratio slow together.
+FLEET_COLLAPSE = 0.4
 
 
 def run_gate_bench() -> dict:
@@ -306,6 +320,38 @@ def compare(payload: dict, baseline: dict, ratio_tolerance: float,
                 f"longer beats K solo dispatches (a lost batch lowering "
                 f"reads ~1.0)")
 
+    # Fleet-layer contract (ISSUE 17): the fleet block must exist on
+    # every exit path (REQUIRED_KEYS), the dedicated section must have
+    # actually measured on a gate run (its parity flags — fleet masks vs
+    # the numpy oracle, replay dedupe — are covered by the parity walk
+    # above), and the N=2-vs-solo jobs/s ratio must not collapse
+    # whenever the baseline demonstrated the >= 1x floor.
+    fl = payload.get("fleet")
+    if isinstance(fl, dict):
+        if fl.get("error"):
+            problems.append(
+                f"fleet section errored: {fl['error']!r} — the "
+                "fleet-layer arm did not measure")
+        elif fl.get("status") == "did_not_run":
+            problems.append(
+                "fleet section did not run (BENCH_SKIP_FLEET or an early "
+                "exit) — the gate requires the fleet-layer arm")
+        elif not isinstance(fl.get("scaling_ratio"), (int, float)):
+            problems.append("fleet block has no scaling_ratio")
+        base_fl = baseline.get("fleet")
+        if (isinstance(base_fl, dict)
+                and isinstance(base_fl.get("scaling_ratio"), (int, float))
+                and base_fl["scaling_ratio"] >= FLEET_FLOOR
+                and isinstance(fl.get("scaling_ratio"), (int, float))
+                and fl["scaling_ratio"] < FLEET_COLLAPSE):
+            problems.append(
+                f"fleet.scaling_ratio collapsed to "
+                f"{fl['scaling_ratio']:.3g} (baseline "
+                f"{base_fl['scaling_ratio']:.3g}, collapse threshold "
+                f"{FLEET_COLLAPSE:g}) — two replicas behind the router "
+                f"no longer keep up with one driven directly (a "
+                f"serialized placement path reads well under 0.4)")
+
     # Cost-accounting contract (ISSUE 15): the costs block must exist on
     # every exit path (REQUIRED_KEYS) and, when the dedicated section
     # ran, must not have errored and must carry the attainment table —
@@ -422,6 +468,10 @@ def history_line(payload: dict, ok: bool) -> dict:
         "ingest_codec_ratio": ing.get("codec_ratio"),
         "coalesce_throughput_ratio": (payload.get("coalesce") or {}
                                       ).get("throughput_ratio"),
+        "fleet_scaling_ratio": (payload.get("fleet") or {}
+                                ).get("scaling_ratio"),
+        "fleet_jobs_per_s": (payload.get("fleet") or {}
+                             ).get("jobs_per_s_fleet"),
         "roofline_attainment": payload.get("roofline_attainment"),
         "ts": round(time.time(), 3),
         "ok": ok,
