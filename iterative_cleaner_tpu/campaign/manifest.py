@@ -17,7 +17,10 @@ A manifest is one JSON object::
         "/data/a.npz": {"shape": [8, 32, 128], "audit": true}
       },                                   // limited to the POST /jobs
                                            // fields: shape/audit/profile
-      "max_inflight": 8                    // per-campaign placement pacing
+      "max_inflight": 8,                   // per-campaign placement pacing
+      "synthetic": false                   // canary micro-campaigns only:
+                                           // stamps every archive job
+                                           // synthetic=true (fleet/canary.py)
     }
 
 ``archives`` keeps submission order and MAY repeat a path — duplicates
@@ -97,13 +100,18 @@ def compile_manifest(raw: dict, campaign_id: str | None = None) -> dict:
     if not isinstance(raw, dict):
         raise ValueError("a campaign manifest must be a JSON object")
     unknown = sorted(set(raw) - {"name", "tenant", "archives", "globs",
-                                 "config", "overrides", "max_inflight"})
+                                 "config", "overrides", "max_inflight",
+                                 "synthetic"})
     if unknown:
         raise ValueError(f"unknown manifest field(s) {unknown}; see "
                          "docs/SERVING.md 'Campaigns' for the grammar")
     cid = campaign_id or new_campaign_id()
     name = str(raw.get("name", "") or cid)
     tenant = str(raw.get("tenant", "") or "default")
+    # Canary micro-campaigns (fleet/canary.py): every archive job is
+    # stamped synthetic=true so the probe stays out of capacity demand,
+    # tenant quotas, and cost showback.
+    synthetic = bool(raw.get("synthetic", False))
     config = raw.get("config") or {}
     if not isinstance(config, dict):
         raise ValueError("manifest config must be a JSON object "
@@ -154,6 +162,7 @@ def compile_manifest(raw: dict, campaign_id: str | None = None) -> dict:
         "id": cid,
         "name": name,
         "tenant": tenant,
+        "synthetic": synthetic,
         "state": "open",
         "created_s": round(time.time(), 3),
         "finished_s": 0.0,

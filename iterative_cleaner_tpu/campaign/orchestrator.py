@@ -338,12 +338,18 @@ class CampaignOrchestrator:
                     sorted(records.values(), key=lambda r: r["index"])
                     if r["state"] == "pending"][:budget]
             tenant = str(camp.get("tenant", "") or "default")
+            synthetic = bool(camp.get("synthetic", False))
         for rec in todo:
             payload = {
                 "path": rec["path"],
                 "idempotency_key": rec["idem_key"],
                 "tenant": tenant,
             }
+            if synthetic:
+                # Canary campaigns (fleet/canary.py): the flag rides
+                # every archive job end-to-end, keeping the probe out of
+                # the demand/quota/cost planes it measures.
+                payload["synthetic"] = True
             payload.update(rec.get("overrides") or {})
             trace_id = rec.get("trace_id") or events.new_trace_id()
             try:

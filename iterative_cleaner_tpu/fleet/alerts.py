@@ -60,7 +60,7 @@ import threading
 import time
 import urllib.request
 import uuid
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 
 from iterative_cleaner_tpu.obs import metrics as obs_metrics
 from iterative_cleaner_tpu.utils import backoff
@@ -98,13 +98,18 @@ class AlertRule:
     for_ticks: int = 1
     labels: tuple = ()
     description: str = ""
+    # Registration provenance, for the rules table: "default" (the
+    # built-in pack), "budget" (fleet/costs.budget_rules), "slo"
+    # (fleet/slo.burn_rules), or "operator" (--alert_rule / JSON file).
+    source: str = "operator"
 
     def to_json(self) -> dict:
         return {"name": self.name, "severity": self.severity,
                 "family": self.family, "labels": dict(self.labels),
                 "predicate": dict(self.predicate),
                 "for_ticks": self.for_ticks,
-                "description": self.description}
+                "description": self.description,
+                "source": self.source}
 
 
 def parse_rule(spec: dict) -> AlertRule:
@@ -174,7 +179,8 @@ def parse_rule(spec: dict) -> AlertRule:
         name=name, severity=severity, family=family, predicate=clean,
         for_ticks=for_ticks,
         labels=tuple(sorted((str(k), str(v)) for k, v in labels.items())),
-        description=str(spec.get("description", "")))
+        description=str(spec.get("description", "")),
+        source=str(spec.get("source", "operator")))
 
 
 def default_rule_pack(poll_interval_s: float = 1.0,
@@ -246,7 +252,7 @@ def default_rule_pack(poll_interval_s: float = 1.0,
             "description": "backlog-drain ETA sits above the scale-up "
                            "threshold while --autoscale is off — the "
                            "fleet is behind and nothing will grow it"}))
-    return rules
+    return [replace(r, source="default") for r in rules]
 
 
 @dataclass

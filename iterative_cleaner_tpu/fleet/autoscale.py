@@ -390,12 +390,17 @@ class Autoscaler:
 
     def tick(self, snapshot: dict, *, alive: int, managed_up: int,
              slo_burn_total: float, stragglers: int,
+             slo_budget_remaining: float | None = None,
              now_mono: float | None = None) -> dict | None:
         """One poll's verdict: None, or a decision dict
         ``{"direction", "reason", "mode", "signals"}``.  ``alive`` is
         live non-draining replicas (the scale bounds); ``managed_up``
         is how many the supervisor could still drain (a fleet of only
-        static replicas never scales down)."""
+        static replicas never scales down).  ``slo_budget_remaining``
+        (the minimum error-budget percentage across declared SLO
+        objectives, fleet/slo.py) rides the decision's signals so every
+        bundle records the budget state it was taken under — the
+        router's canary veto is the acting half of that signal."""
         fleet = (snapshot or {}).get("fleet")
         if not fleet:
             return None
@@ -437,6 +442,9 @@ class Autoscaler:
                     "up_streak": self._up_streak,
                     "down_streak": self._down_streak,
                 }
+                if slo_budget_remaining is not None:
+                    decision["signals"]["slo_budget_remaining_pct"] = (
+                        slo_budget_remaining)
                 self._last_decision_mono = now
                 self._last_decision = dict(decision)
                 self._up_streak = 0

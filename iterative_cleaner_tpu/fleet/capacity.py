@@ -132,16 +132,27 @@ class CapacityModel:
         self._done_prev: dict[str, float] = {}  # ict: guarded-by(self._lock)
         self._last_mono: float | None = None  # ict: guarded-by(self._lock)
         self._snapshot: dict = {}  # ict: guarded-by(self._lock)
+        # Lifetime demand counter (never windowed away): the synthetic-
+        # exclusion proof the canary lane leans on — a probe round with
+        # zero movement here provably never entered the demand plane.
+        self._noted_total = 0  # ict: guarded-by(self._lock)
 
     # --- inputs ---
 
     def note_placement(self, bucket: str) -> None:
-        """One fresh placement routed (demand).  Failover re-routes and
-        idempotency dedupes must NOT call this — they are the same
-        demand arriving twice."""
+        """One fresh placement routed (demand).  Failover re-routes,
+        idempotency dedupes, and synthetic canary probes must NOT call
+        this — the first two are the same demand arriving twice, the
+        probes are not demand at all (fleet/canary.py)."""
         key = bucket or UNBUCKETED
         with self._lock:
             self._arrivals[key] = self._arrivals.get(key, 0) + 1
+            self._noted_total += 1
+
+    def demand_total(self) -> int:
+        """Cumulative count of placements ever noted (not windowed)."""
+        with self._lock:
+            return self._noted_total
 
     # --- the per-tick fold ---
 

@@ -124,5 +124,43 @@ class ReplicaClient:
     def job(self, base_url: str, job_id: str) -> dict:
         return self._call(f"{base_url}/jobs/{job_id}")
 
+    # --- streaming-session proxy (the router's /sessions surface) ---
+
+    def session_open(self, base_url: str, body: dict) -> dict:
+        """POST /sessions on one replica (SessionMeta dict + optional
+        out_path/alert_iters) — the router's session-proxy open hop."""
+        return self._call(f"{base_url}/sessions", body=body)
+
+    def session_block(self, base_url: str, sid: str,
+                      payload: bytes) -> dict:
+        """POST /sessions/<id>/blocks: one encoded subint block, raw wire
+        bytes (online/blocks.py codec) forwarded verbatim."""
+        req = urllib.request.Request(
+            f"{base_url}/sessions/{sid}/blocks", data=payload,
+            headers={"Content-Type": "application/octet-stream"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                reply = json.load(resp)
+        except urllib.error.HTTPError as exc:
+            try:
+                detail = json.load(exc)
+                if not isinstance(detail, dict):
+                    detail = {"error": str(detail)}
+            except ValueError:
+                detail = {"error": exc.reason}
+            raise ReplicaRefused(exc.code, detail) from exc
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError) as exc:
+            raise ReplicaUnreachable(f"{base_url}: {exc}") from exc
+        if not isinstance(reply, dict):
+            raise ReplicaUnreachable(f"{base_url}: non-object JSON reply")
+        return reply
+
+    def session_finish(self, base_url: str, sid: str) -> dict:
+        return self._call(f"{base_url}/sessions/{sid}/finish", body={})
+
+    def session_get(self, base_url: str, sid: str) -> dict:
+        return self._call(f"{base_url}/sessions/{sid}")
+
     def drain(self, base_url: str, flag: bool = True) -> dict:
         return self._call(f"{base_url}/drain", body={"drain": bool(flag)})

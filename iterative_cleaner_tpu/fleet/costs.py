@@ -40,6 +40,7 @@ from iterative_cleaner_tpu.fleet.capacity import (
     counter_value,
     labeled_gauge_values,
 )
+from iterative_cleaner_tpu.fleet.tenants import SYNTHETIC_TENANT
 from iterative_cleaner_tpu.obs import metrics as obs_metrics
 
 #: |conservation_ratio - 1| beyond this is an attribution bug (the smoke
@@ -146,6 +147,12 @@ def fold(replica_rows: list[dict], scrapes: dict[str, dict],
                                    if dispatch_s > 0 else None),
         }
 
+    # Canary traffic is excluded from SHOWBACK, not from conservation:
+    # the reserved synthetic tenant's device time stays in each replica's
+    # cost_s sum above (attribution must still conserve against dispatch
+    # seconds — probe work is real work), but it is nobody's bill, so the
+    # tenant table never grows a "_canary" row (ISSUE 18).
+    tenants.pop(SYNTHETIC_TENANT, None)
     for tenant, budget in budgets.items():
         row = tenant_row(tenant)
         row["budget_device_s"] = float(budget)
@@ -204,6 +211,7 @@ def budget_rules(budgets: dict[str, float],
             continue
         rules.append(fleet_alerts.parse_rule({
             "name": f"tenant_budget_burn:{tenant}",
+            "source": "budget",
             "severity": "warning",
             "family": "ict_fleet_tenant_budget_used_pct",
             "labels": {"tenant": tenant},
@@ -215,6 +223,7 @@ def budget_rules(budgets: dict[str, float],
                            "accounting\")"}))
         rules.append(fleet_alerts.parse_rule({
             "name": f"tenant_budget_exhausted:{tenant}",
+            "source": "budget",
             "severity": "critical",
             "family": "ict_fleet_tenant_budget_used_pct",
             "labels": {"tenant": tenant},

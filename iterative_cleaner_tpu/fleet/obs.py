@@ -301,6 +301,34 @@ class TraceStore:
             return rec["job_id"] if rec else ""
 
 
+def span_hops(spans: list[dict]) -> dict:
+    """Per-hop latency off one assembled trace: consecutive span deltas
+    in timestamp order, each hop labeled ``source:event`` ->
+    ``source:event``.  The canary prober stamps this on every journey
+    verdict (ISSUE 18), re-using the trace assembly instead of growing a
+    second timing path; unstamped spans are skipped, < 2 stamped spans
+    yield no hops."""
+    stamped = []
+    for s in spans:
+        try:
+            ts = float(s.get("ts", 0.0))
+        except (TypeError, ValueError):
+            continue
+        if ts <= 0.0:
+            continue
+        source = str(s.get("source", "") or "")
+        event = str(s.get("event", "") or "")
+        label = f"{source}:{event}" if source else event
+        stamped.append((ts, label))
+    stamped.sort(key=lambda pair: pair[0])
+    hops = []
+    for (t0, l0), (t1, l1) in zip(stamped, stamped[1:]):
+        hops.append({"from": l0, "to": l1, "dt_s": round(t1 - t0, 6)})
+    total = (round(stamped[-1][0] - stamped[0][0], 6)
+             if len(stamped) >= 2 else 0.0)
+    return {"hops": hops, "total_s": total}
+
+
 class StragglerDetector:
     """Windowed per-replica latency p50 vs the fleet median.
 

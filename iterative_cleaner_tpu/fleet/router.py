@@ -55,10 +55,12 @@ from iterative_cleaner_tpu.campaign.store import CampaignStore
 from iterative_cleaner_tpu.fleet import alerts as fleet_alerts
 from iterative_cleaner_tpu.fleet import autoscale as fleet_autoscale
 from iterative_cleaner_tpu.fleet import cache as fleet_cache
+from iterative_cleaner_tpu.fleet import canary as fleet_canary
 from iterative_cleaner_tpu.fleet import capacity as fleet_capacity
 from iterative_cleaner_tpu.fleet import costs as fleet_costs
 from iterative_cleaner_tpu.fleet import history as fleet_history
 from iterative_cleaner_tpu.fleet import obs as fleet_obs
+from iterative_cleaner_tpu.fleet import slo as fleet_slo
 from iterative_cleaner_tpu.fleet.client import (
     ReplicaClient,
     ReplicaRefused,
@@ -67,12 +69,14 @@ from iterative_cleaner_tpu.fleet.client import (
 from iterative_cleaner_tpu.fleet.registry import Replica, ReplicaRegistry
 from iterative_cleaner_tpu.fleet.tenants import (
     DEFAULT_TENANT,
+    SYNTHETIC_TENANT,
     QuotaExceeded,
     TenantAdmission,
     WeightedFairQueue,
 )
 from iterative_cleaner_tpu.obs import events, flight
 from iterative_cleaner_tpu.obs import metrics as obs_metrics
+from iterative_cleaner_tpu.obs import tracing as obs_tracing
 from iterative_cleaner_tpu.service.scheduler import bucket_label
 from iterative_cleaner_tpu.utils import backoff
 
@@ -184,6 +188,11 @@ class FleetConfig:
     alert_cmd: str = ""              # shell command per transition
                                      # (the JSON on stdin)
     alert_retries: int = 3           # delivery retries per sink
+    canary_ticks: int = 0            # poll ticks between canary probe
+                                     # rounds (fleet/canary.py; 0 = off)
+    slo: tuple = ()                  # declarative SLO objective specs
+                                     # (--slo JOURNEY:TARGET:WINDOW_TICKS;
+                                     # fleet/slo.py)
     quiet: bool = False
 
 
@@ -223,6 +232,11 @@ class Placement:
                                     # genuinely lost the job, and the
                                     # placement must fail terminally
                                     # instead of leaking its slot forever
+    synthetic: bool = False         # a canary probe placement: it never
+                                    # took an admission slot, a WFQ
+                                    # grant, or capacity demand, so the
+                                    # terminal transition must not hand
+                                    # any of them back (fleet/canary.py)
 
 
 def new_router_id() -> str:
@@ -257,6 +271,10 @@ class RouterMetrics:
         # (family, ((label, value), ...)) -> float
         self._counters: dict = {}  # ict: guarded-by(self._lock)
         self._gauges: dict = {}  # ict: guarded-by(self._lock)
+        # (family, label_pairs) -> [per-bucket counts (len(HIST_BOUNDS)
+        # + 1, trailing +Inf overflow), running sum] on the fixed log2
+        # bounds — the canary journey-latency histograms.
+        self._hists: dict = {}  # ict: guarded-by(self._lock)
 
     @staticmethod
     def _key(family: str, labels: dict | None):
@@ -282,6 +300,35 @@ class RouterMetrics:
         with self._lock:
             self._gauges[self._key(family, labels)] = float(value)
 
+    def observe_hist(self, family: str, labels: dict | None,
+                     value: float) -> None:
+        """One observation into a fixed log2-bounds histogram series
+        (the obs/tracing bucket walk; series appear on first
+        observation or via :meth:`ensure_hist`)."""
+        key = self._key(family, labels)
+        with self._lock:
+            rec = self._hists.get(key)
+            if rec is None:
+                rec = [[0.0] * (len(obs_tracing.HIST_BOUNDS) + 1), 0.0]
+                self._hists[key] = rec
+            buckets = rec[0]
+            for i, bound in enumerate(obs_tracing.HIST_BOUNDS):
+                if value <= bound:
+                    buckets[i] += 1.0
+                    break
+            else:
+                buckets[-1] += 1.0
+            rec[1] += float(value)
+
+    def ensure_hist(self, family: str, labels: dict | None) -> None:
+        """Pre-register one zero-count histogram series (the gauge
+        pre-registration lesson applied to histograms: a documented
+        family must be live on the first scrape)."""
+        key = self._key(family, labels)
+        with self._lock:
+            self._hists.setdefault(
+                key, [[0.0] * (len(obs_tracing.HIST_BOUNDS) + 1), 0.0])
+
     def replace_gauge_family(self, family: str,
                              entries: dict[tuple, float]) -> None:
         """Swap every sample of one gauge family atomically — per-replica
@@ -302,7 +349,9 @@ class RouterMetrics:
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
-        return obs_metrics.render_registries(counters, gauges)
+            hists = {key: (obs_tracing.HIST_BOUNDS, list(rec[0]), rec[1])
+                     for key, rec in self._hists.items()}
+        return obs_metrics.render_registries(counters, gauges, hists=hists)
 
 
 class _Ticket:
@@ -384,6 +433,12 @@ class FleetRouter:
         # --no_default_alerts — a declared budget nobody watches would
         # be a lie); operator --alert_rule names still override.
         rules.extend(fleet_costs.budget_rules(cfg.tenant_budgets))
+        # SLO burn-rate rules (fleet/slo.py; ISSUE 18): two multiwindow
+        # rules per declared objective over the router-computed
+        # ict_sli_burn_rate gauge, installed the budget_rules way —
+        # before the operator loop, so --alert_rule names still replace.
+        self._slo_objectives = fleet_slo.parse_slo_specs(cfg.slo)
+        rules.extend(fleet_slo.burn_rules(self._slo_objectives))
         for spec in cfg.alert_rules:
             rule = (spec if isinstance(spec, fleet_alerts.AlertRule)
                     else fleet_alerts.parse_rule(spec))
@@ -464,6 +519,51 @@ class FleetRouter:
         # tests/test_metric_docs.py), not only once a campaign exists.
         for family, entries in self.campaigns.gauge_families().items():
             self.metrics.replace_gauge_family(family, entries)
+        # The SLI/error-budget plane (fleet/slo.py) — ALWAYS constructed
+        # (SLIs render for every journey even with no --slo objectives;
+        # the spool-persisted ledger rehydrates budget accounting across
+        # restarts) — and the black-box canary prober (fleet/canary.py)
+        # probing the router's own public HTTP surface on the
+        # --canary_ticks cadence.
+        self.slo = fleet_slo.SloPlane(
+            self._slo_objectives, cfg.spool_dir, metrics=self.metrics,
+            quiet=cfg.quiet)
+        self.canary = fleet_canary.CanaryProber(
+            cfg.spool_dir,
+            lambda: f"http://{self.cfg.host}:{self.port}",
+            quiet=cfg.quiet)
+        self.canary.slo = self.slo
+        self.canary.on_mask_mismatch = self._canary_mismatch
+        # Poll ticks until the next canary round (counts down each
+        # _slo_tick when probing is enabled; first round fires on the
+        # first tick so the smoke and a fresh fleet get a verdict
+        # immediately).
+        self._ticks_to_canary = 1 if cfg.canary_ticks > 0 else 0  # ict: guarded-by(self._lock)
+        # Pre-register the whole SLI/canary surface at zero (the budget
+        # gauge lesson): gauges via the plane's own families, counters
+        # and the journey-latency histogram as explicit zero series, so
+        # every documented ict_sli_*/ict_canary_* family is live on the
+        # first scrape and burn rules can fire AND resolve from tick 1.
+        for family, entries in self.slo.gauge_families().items():
+            self.metrics.replace_gauge_family(family, entries)
+        for j in fleet_slo.JOURNEYS:
+            self.metrics.count("sli_good_events_total", {"journey": j},
+                               inc=0.0)
+            self.metrics.count("sli_bad_events_total", {"journey": j},
+                               inc=0.0)
+        for j in fleet_slo.CANARY_JOURNEYS:
+            for outcome in ("ok", "fail"):
+                self.metrics.count("canary_probes_total",
+                                   {"journey": j, "outcome": outcome},
+                                   inc=0.0)
+            self.metrics.count("canary_mask_mismatches_total",
+                               {"journey": j}, inc=0.0)
+            self.metrics.ensure_hist(fleet_slo.CANARY_HIST_FAMILY,
+                                     {"journey": j})
+        # Streaming-session proxy routes: fleet session id -> (replica
+        # base_url, trace_id), bounded FIFO so an abandoned session can
+        # never grow the map without bound.
+        self._session_routes: dict[str, tuple] = {}  # ict: guarded-by(self._lock)
         # Last observed (audit_divergences, backend) per replica: the
         # incident watch fires a bundle when divergences move or a
         # replica demotes jax -> numpy between polls.
@@ -588,6 +688,7 @@ class FleetRouter:
         self._update_capacity()
         self._update_costs()
         self._campaign_tick()
+        self._slo_tick()
         self._autoscale_tick()
         self._history_alert_tick()
         self._trim_placements()
@@ -908,6 +1009,50 @@ class FleetRouter:
         for family, entries in self.campaigns.gauge_families().items():
             self.metrics.replace_gauge_family(family, entries)
 
+    def _slo_tick(self) -> None:
+        """One tick of the SLI/error-budget plane (fleet/slo.py): fold
+        the PR-10 grant-wait counters into the derived ``admission``
+        journey, kick a canary probe round when the --canary_ticks
+        cadence says so (on the prober's own thread — the poll loop
+        never blocks on a probe), close the ledger tick, and republish
+        every ``ict_sli_*`` gauge family whole (the capacity/cost
+        snapshot-then-replace discipline).  Runs BEFORE the autoscale
+        tick so this tick's budget state is the signal the scaler
+        reads."""
+        # Good events for the admission journey are the admissions the
+        # router granted (synthetic probes skip admission entirely, so
+        # canary traffic can never move this SLI); bad events are the
+        # PR-10 grant-wait burns.
+        self.slo.note_admission(
+            burned_total=self.metrics.counter_total("fleet_slo_burn_total"),
+            placed_total=self.metrics.counter_total(
+                "fleet_tenant_admissions_total"))
+        if self.cfg.canary_ticks > 0:
+            with self._lock:
+                self._ticks_to_canary -= 1
+                fire = self._ticks_to_canary <= 0
+                if fire:
+                    self._ticks_to_canary = self.cfg.canary_ticks
+            if fire:
+                self.canary.maybe_start()
+        self.slo.end_tick()
+        for family, entries in self.slo.gauge_families().items():
+            self.metrics.replace_gauge_family(family, entries)
+
+    def _canary_mismatch(self, verdict: dict) -> None:
+        """A canary probe observed a mask that is NOT bit-identical to
+        the stored oracle answer — the one correctness signal the fleet
+        exists to protect.  Full incident bundle, the audit-divergence
+        discipline."""
+        self._note_incident("canary_mask_mismatch",
+                            job_id=str(verdict.get("job_id", "") or ""),
+                            trace_id=str(verdict.get("trace_id", "") or ""))
+        if not self.cfg.quiet:
+            print(f"ict-fleet: CANARY mask mismatch on journey "
+                  f"{verdict.get('journey')!r} "
+                  f"(trace {verdict.get('trace_id') or '-'})",
+                  file=sys.stderr)
+
     def _autoscale_tick(self) -> None:
         """The control loop's acting half: reap finished drains, ask the
         Autoscaler for this tick's verdict, and (in act mode) execute it
@@ -953,7 +1098,8 @@ class FleetRouter:
             managed_up=len(self.supervisor.up_ids()),
             slo_burn_total=self.metrics.counter_total(
                 "fleet_slo_burn_total"),
-            stragglers=len(self.straggler.stragglers()))
+            stragglers=len(self.straggler.stragglers()),
+            slo_budget_remaining=self.slo.min_budget_remaining())
         if decision is None:
             return
         direction, reason = decision["direction"], decision["reason"]
@@ -980,6 +1126,19 @@ class FleetRouter:
         # fine on the poll thread; the drain itself completes over later
         # ticks (reap_drained above).
         victim = self._pick_scale_down_victim()
+        veto = self._canary_scale_veto(victim) if victim else ""
+        if veto:
+            # Budget state as an autoscaler input (ISSUE 18): a failing
+            # canary journey means users may already be getting wrong or
+            # no answers — shrinking the last replica warm for the
+            # canary bucket would destroy the capacity serving the very
+            # journey that is failing.  The decision is consumed (the
+            # Autoscaler armed its cooldown), so it must stay visible:
+            # recorded as vetoed, never silently dropped.
+            decision["error"] = veto
+            self._record_scale_outcome(decision, "fleet_scale_vetoed",
+                                       acted=False)
+            return
         if not victim or not self.supervisor.begin_drain(victim):
             # Un-executable down decision (nothing drainable, or the
             # drain call failed).  The Autoscaler already consumed the
@@ -1059,6 +1218,35 @@ class FleetRouter:
         # reason mirrors the event: scale_up / scale_down /
         # scale_advised / scale_failed.
         self._note_scale_bundle(decision, event[len("fleet_"):])
+
+    def _canary_scale_veto(self, victim: str) -> str:
+        """The scale-down veto (ISSUE 18): with any canary journey
+        failing, refuse to drain the LAST replica serving the canary
+        shape bucket — removing it would take down the only capacity the
+        failing journey still routes to.  Returns the veto reason, or ""
+        to let the drain proceed.  ``victim`` is the supervisor's
+        managed id; the registry speaks base URLs, so the check maps
+        through ``up_urls``."""
+        failing = self.slo.failing_journeys()
+        if not failing:
+            return ""
+        by_managed = {mid: url
+                      for url, mid in self.supervisor.up_urls().items()}
+        victim_url = by_managed.get(victim, "")
+        bucket = bucket_label(fleet_canary.CANARY_SHAPE)
+        others_warm = [
+            rep for rep in self.registry.candidates()
+            if rep.base_url != victim_url
+            # A numpy replica has no executables to warm — it serves any
+            # bucket at full speed immediately, so it always counts.
+            and (rep.health.get("backend") == "numpy"
+                 or bucket in rep.warm_buckets()
+                 or rep.queued_buckets().get(bucket, 0) > 0)]
+        if others_warm:
+            return ""
+        return (f"scale-down vetoed: canary journey(s) "
+                f"{', '.join(sorted(failing))} failing and no other "
+                f"replica serves the canary bucket {bucket!r}")
 
     def _pick_scale_down_victim(self) -> str:
         """The least-loaded managed-up replica — never a statically
@@ -1195,6 +1383,16 @@ class FleetRouter:
         # submission) keeps its tenant rather than silently rebranding
         # to the default.
         tenant = str(tenant or payload.get("tenant", "") or DEFAULT_TENANT)
+        # Synthetic canary traffic (fleet/canary.py) is normalized HERE,
+        # authoritatively: the flag and the reserved tenant imply each
+        # other, so every downstream exclusion (admission, WFQ grant,
+        # capacity demand, cost showback, cache-salt scoping) keys on one
+        # consistent identity however the probe entered (direct POST, a
+        # synthetic campaign's orchestrator placement, a failover
+        # re-route of either).
+        if payload.get("synthetic") or tenant == SYNTHETIC_TENANT:
+            payload["synthetic"] = True
+            tenant = SYNTHETIC_TENANT
         payload["tenant"] = tenant
         key = str(payload.get("idempotency_key", "") or "")
         known = self._resolve_idem(key)
@@ -1283,6 +1481,13 @@ class FleetRouter:
             self.metrics.count("fleet_cache_skips_total",
                                {"reason": "no_unanimous_salt"})
             return None
+        if payload.get("synthetic"):
+            # Canary probes live in their own salt scope (the recording
+            # half suffixes identically): a probe can hit entries other
+            # probes learned — the cache journey NEEDS that — but can
+            # never be served a real tenant's entry nor seed one real
+            # traffic would reuse.
+            salt = salt + "|synthetic"
         from iterative_cleaner_tpu.ingest import cas
 
         digest = cas.file_digest(str(payload.get("path", "") or ""))
@@ -1328,7 +1533,8 @@ class FleetRouter:
             payload=payload, base_url="",
             replica_id=origin.get("replica_id", ""),
             replica_job_id=origin.get("job_id", ""), state="done",
-            submitted_s=time.time(), cached=manifest)
+            submitted_s=time.time(), cached=manifest,
+            synthetic=bool(payload.get("synthetic")))
         with self._lock:
             self._placements[job_id] = placement
             if key:
@@ -1387,31 +1593,39 @@ class FleetRouter:
 
     def _place_fresh(self, payload: dict, tenant: str, trace_id: str,
                      key: str) -> dict:
-        try:
-            self.admission.admit(tenant)
-        except QuotaExceeded:
-            self.metrics.count("fleet_tenant_rejections_total",
+        synthetic = bool(payload.get("synthetic"))
+        # Synthetic canary probes bypass the ENTIRE admission plane:
+        # no quota ledger entry, no admissions count (the admission
+        # journey's good-event source), no WFQ grant (they must never
+        # displace a real tenant's slot) — the terminal transition
+        # releases nothing for them (Placement.synthetic, symmetric).
+        if not synthetic:
+            try:
+                self.admission.admit(tenant)
+            except QuotaExceeded:
+                self.metrics.count("fleet_tenant_rejections_total",
+                                   {"tenant": tenant})
+                raise
+            self.metrics.count("fleet_tenant_admissions_total",
                                {"tenant": tenant})
-            raise
-        self.metrics.count("fleet_tenant_admissions_total",
-                           {"tenant": tenant})
-        try:
-            self._await_grant(tenant)
-        except BaseException:
-            self.admission.release(tenant)
-            raise
+            try:
+                self._await_grant(tenant)
+            except BaseException:
+                self.admission.release(tenant)
+                raise
         try:
             rep, body = self._submit_with_failover(payload, trace_id)
         except BaseException:
-            self._release_slot()
-            self.admission.release(tenant)
+            if not synthetic:
+                self._release_slot()
+                self.admission.release(tenant)
             raise
         placement = Placement(
             job_id=str(body.get("id", "")),
             tenant=tenant, trace_id=trace_id, payload=payload,
             base_url=rep.base_url, replica_id=rep.replica_id,
             replica_job_id=str(body.get("id", "")),
-            submitted_s=time.time())
+            submitted_s=time.time(), synthetic=synthetic)
         placement.hops.append({"replica_id": rep.replica_id,
                                "base_url": rep.base_url,
                                "replica_job_id": placement.replica_job_id,
@@ -1429,15 +1643,19 @@ class FleetRouter:
             # placement keeps the in-flight slot and the quota count, so
             # the retry's admit/grant must be handed back here — silently
             # replacing the record would leak one of each per retry.
-            self._release_slot()
-            self.admission.release(tenant)
+            if not synthetic:
+                self._release_slot()
+                self.admission.release(tenant)
             return {**body, "tenant": tenant, "router_id": self.router_id}
         self.metrics.count("fleet_placements_total",
                            {"replica": rep.replica_id or rep.base_url})
         # Fresh demand only: failover re-routes and idempotent dedupes
         # never reach here, so the capacity model's demand rate counts
-        # each submission exactly once.
-        self.capacity.note_placement(self._bucket_of(payload))
+        # each submission exactly once.  Synthetic probes count NOTHING:
+        # demand the canary itself injected would feed the very
+        # autoscaler signal the canary is supposed to measure.
+        if not synthetic:
+            self.capacity.note_placement(self._bucket_of(payload))
         self.traces.record(trace_id, "fleet_submit", job_id=placement.job_id,
                            tenant=tenant)
         self.traces.record(trace_id, "fleet_placement",
@@ -1630,8 +1848,7 @@ class FleetRouter:
                 # dispatch), so a later read refreshes the learned
                 # entry with the finalized avoided-cost figures.
                 if manifest.get("state") == "done":
-                    self.result_index.record(manifest,
-                                             origin_replica=p.replica_id)
+                    self._cache_record(p, manifest)
                 return 200, {**manifest, "id": p.job_id,
                              "replica_id": p.replica_id, "tenant": p.tenant}
             except ReplicaRefused:
@@ -1659,11 +1876,23 @@ class FleetRouter:
             # byte-identical submission — observed here because the
             # status polls already fetch these manifests, zero extra
             # traffic.
-            if self.result_index.record(manifest,
-                                        origin_replica=p.replica_id):
+            if self._cache_record(p, manifest):
                 self.metrics.replace_gauge_family(
                     "fleet_cache_entries",
                     {(): float(len(self.result_index))})
+
+    def _cache_record(self, p: Placement, manifest: dict) -> bool:
+        """Record one DONE manifest into the fleet result index, with a
+        synthetic placement's entry re-salted into the canary scope
+        (``<salt>|synthetic``, the `_resolve_cached` lookup's twin) so
+        probe results and real tenants' results can never serve each
+        other."""
+        if p.synthetic and manifest.get("cache_salt"):
+            manifest = {**manifest,
+                        "cache_salt": str(manifest["cache_salt"])
+                        + "|synthetic"}
+        return self.result_index.record(manifest,
+                                        origin_replica=p.replica_id)
 
     def _mark_terminal(self, p: Placement, state: str,
                        error: str = "") -> None:
@@ -1674,9 +1903,14 @@ class FleetRouter:
                 return
             p.state = state
             p.error = error
-            self._inflight -= 1
-            self._grant_free_slots()
-        self.admission.release(p.tenant)
+            if not p.synthetic:
+                self._inflight -= 1
+                self._grant_free_slots()
+        # A synthetic probe never took a quota entry or an in-flight
+        # slot (fleet/canary.py), so there is nothing to hand back —
+        # releasing would corrupt the real tenants' accounting.
+        if not p.synthetic:
+            self.admission.release(p.tenant)
         self.metrics.count("fleet_jobs_completed_total", {"state": state})
         self.traces.record(p.trace_id, f"fleet_{state}", job_id=p.job_id,
                            replica_id=p.replica_id,
@@ -1829,6 +2063,113 @@ class FleetRouter:
                      "state": state, "hops": hops, "sources": sources,
                      "spans": stitched}
 
+    # --- the streaming-session proxy (the canary session journey's
+    # substrate, and a real user path: one front door for streams too) ---
+
+    #: Bound on remembered session routes (FIFO eviction) — an abandoned
+    #: session must not grow the map forever.
+    SESSION_ROUTES_KEEP = 1024
+
+    def session_open(self, body: dict) -> tuple[int, dict]:
+        """``POST /sessions``: place a streaming session on the
+        least-loaded candidate and remember the route (session id ->
+        replica) for its blocks/finish/status calls.  Sessions pin to
+        ONE replica for their whole life — a stream's state lives in
+        that replica's OnlineSession; there is no failover re-route."""
+        cands = self._ranked_candidates("", set())
+        if not cands:
+            return 503, {"error": "no live replica to host the session"}
+        rep = cands[0]
+        try:
+            reply = self.client.session_open(rep.base_url, body)
+        except ReplicaRefused as exc:
+            return exc.status, exc.body
+        except ReplicaUnreachable as exc:
+            return 502, {"error": f"replica unreachable on session "
+                                  f"open: {exc}"}
+        sid = str(reply.get("id", ""))
+        trace_id = str(reply.get("trace_id", "") or "")
+        if sid:
+            with self._lock:
+                self._session_routes[sid] = (rep.base_url, trace_id)
+                while (len(self._session_routes)
+                       > self.SESSION_ROUTES_KEEP):
+                    self._session_routes.pop(
+                        next(iter(self._session_routes)))
+        if trace_id:
+            # The router adopts the REPLICA-minted trace id (the create
+            # reply carries it), so the fleet-side spans interleave with
+            # the replica's own session telemetry under one id.
+            self.traces.record(trace_id, "fleet_session_open",
+                               session_id=sid,
+                               replica_id=rep.replica_id)
+        return 201, {**reply, "replica_id": rep.replica_id,
+                     "router_id": self.router_id}
+
+    def _session_route(self, sid: str) -> tuple | None:
+        with self._lock:
+            return self._session_routes.get(sid)
+
+    def session_block(self, sid: str,
+                      payload: bytes) -> tuple[int, dict]:
+        route = self._session_route(sid)
+        if route is None:
+            return 404, {"error": f"no session {sid!r} routed through "
+                                  "this router"}
+        try:
+            reply = self.client.session_block(route[0], sid, payload)
+        except ReplicaRefused as exc:
+            return exc.status, exc.body
+        except ReplicaUnreachable as exc:
+            return 502, {"error": f"replica unreachable mid-stream: {exc}"}
+        return 200, {**reply, "router_id": self.router_id}
+
+    def session_finish(self, sid: str) -> tuple[int, dict]:
+        route = self._session_route(sid)
+        if route is None:
+            return 404, {"error": f"no session {sid!r} routed through "
+                                  "this router"}
+        try:
+            reply = self.client.session_finish(route[0], sid)
+        except ReplicaRefused as exc:
+            return exc.status, exc.body
+        except ReplicaUnreachable as exc:
+            return 502, {"error": f"replica unreachable on finish: {exc}"}
+        if route[1]:
+            self.traces.record(route[1], "fleet_session_finish",
+                               session_id=sid,
+                               state=str(reply.get("state", "")))
+        return 200, {**reply, "router_id": self.router_id}
+
+    def session_get(self, sid: str) -> tuple[int, dict]:
+        route = self._session_route(sid)
+        if route is None:
+            return 404, {"error": f"no session {sid!r} routed through "
+                                  "this router"}
+        try:
+            reply = self.client.session_get(route[0], sid)
+        except ReplicaRefused as exc:
+            return exc.status, exc.body
+        except ReplicaUnreachable as exc:
+            return 502, {"error": f"replica unreachable: {exc}"}
+        return 200, {**reply, "router_id": self.router_id}
+
+    def fleet_slo(self) -> dict:
+        """``GET /fleet/slo``: the SLI/error-budget report (per-journey
+        availability/correctness/latency quantiles, burn rates, budget
+        remaining, last verdicts) plus the prober's own state — strict
+        JSON, the /fleet/capacity IEEE-specials discipline."""
+        return _json_safe({
+            **self.slo.report(),
+            "canary": {
+                "enabled": self.cfg.canary_ticks > 0,
+                "cadence_ticks": self.cfg.canary_ticks,
+                "rounds": self.canary.rounds(),
+                "busy": self.canary.busy(),
+            },
+            "router_id": self.router_id,
+        })
+
     def health(self) -> dict:
         from iterative_cleaner_tpu import __version__
 
@@ -1883,6 +2224,17 @@ class FleetRouter:
                     "fleet_cache_hits_total")),
                 "misses": int(self.metrics.counter_value(
                     "fleet_cache_misses_total")),
+            },
+            # The SLI/error-budget plane (fleet/slo.py): enough for a
+            # load balancer or fleet_top to see "a journey is failing"
+            # without a second request; GET /fleet/slo has the rest.
+            "slo": {
+                "objectives": len(self._slo_objectives),
+                "failing_journeys": self.slo.failing_journeys(),
+                "min_budget_remaining_pct": _json_safe(
+                    self.slo.min_budget_remaining()),
+                "canary_enabled": self.cfg.canary_ticks > 0,
+                "canary_rounds": self.canary.rounds(),
             },
         }
 
@@ -1989,6 +2341,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._reply(200, router.fleet_capacity())
         elif self.path == "/fleet/costs":
             self._reply(200, router.fleet_costs())
+        elif self.path == "/fleet/slo":
+            self._reply(200, router.fleet_slo())
         elif self.path.startswith("/fleet/trace/"):
             tid = self.path[len("/fleet/trace/"):]
             code, payload = router.fleet_trace(tid)
@@ -2012,6 +2366,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
         elif self.path.startswith("/jobs/"):
             jid = self.path[len("/jobs/"):]
             code, payload = router.job_manifest(jid)
+            self._reply(code, payload)
+        elif self.path.startswith("/sessions/"):
+            sid = self.path[len("/sessions/"):]
+            code, payload = router.session_get(sid)
             self._reply(code, payload)
         else:
             self._reply(404, {"error": f"no such route {self.path!r}"})
@@ -2044,6 +2402,34 @@ class _RouterHandler(BaseHTTPRequestHandler):
             else:
                 self._reply(200, _json_safe(row))
             return
+        if self.path == "/sessions":
+            try:
+                body = json.loads(self._read_body() or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+            except ValueError as exc:
+                self._reply(400, {"error": f"bad session body: {exc}"})
+                return
+            code, payload = router.session_open(body)
+            self._reply(code, payload)
+            return
+        if (self.path.startswith("/sessions/")
+                and self.path.endswith("/blocks")):
+            sid = self.path[len("/sessions/"): -len("/blocks")]
+            # Raw block bytes, same cap the single-replica daemon
+            # enforces (online/blocks.py) so the proxy never truncates
+            # a body the replica would have accepted.
+            from iterative_cleaner_tpu.online.blocks import MAX_BLOCK_BYTES
+            code, payload = router.session_block(
+                sid, self._read_body(limit=MAX_BLOCK_BYTES))
+            self._reply(code, payload)
+            return
+        if (self.path.startswith("/sessions/")
+                and self.path.endswith("/finish")):
+            sid = self.path[len("/sessions/"): -len("/finish")]
+            code, payload = router.session_finish(sid)
+            self._reply(code, payload)
+            return
         if (self.path.startswith("/replicas/")
                 and self.path.endswith("/drain")):
             rid = self.path[len("/replicas/"): -len("/drain")]
@@ -2072,6 +2458,11 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 # mints one — it is what makes failover re-routes safe.
                 "idempotency_key": str(body.get("idempotency_key", "")
                                        or f"fleet-{uuid.uuid4().hex[:16]}"),
+                # Canary probes self-identify; place_job rebrands them
+                # onto the reserved synthetic tenant so every exclusion
+                # plane (admission, capacity, costs, cache salt) keys
+                # off one identity (fleet/slo.py "synthetic traffic").
+                "synthetic": bool(body.get("synthetic", False)),
             }
             shape = body.get("shape")
             if shape is not None:
@@ -2268,6 +2659,19 @@ def build_fleet_parser() -> argparse.ArgumentParser:
     p.add_argument("--alert_retries", type=int, default=3, metavar="N",
                    help="full-jitter delivery retries per alert sink "
                         "(default 3)")
+    p.add_argument("--canary_ticks", type=int, default=0, metavar="N",
+                   help="poll ticks between black-box canary probe rounds "
+                        "through the router's own HTTP surface (fresh job, "
+                        "cache resubmit, streaming session, micro-campaign; "
+                        "each verdict bit-checks the mask against a stored "
+                        "oracle; 0 = off, the default)")
+    p.add_argument("--slo", action="append", default=[],
+                   metavar="JOURNEY:TARGET:WINDOW_TICKS",
+                   help="declarative SLO objective, repeatable — e.g. "
+                        "fresh:0.99:512; registers two multiwindow "
+                        "burn-rate alert rules per objective and a "
+                        "spool-persisted error-budget ledger "
+                        "(journeys: " + ", ".join(fleet_slo.JOURNEYS) + ")")
     p.add_argument("-q", "--quiet", action="store_true")
     p.add_argument("--smoke", action="store_true",
                    help="offline self-check: 2 in-process replicas behind "
@@ -2359,6 +2763,10 @@ def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
     if args.alert_retries < 0:
         raise ValueError(f"--alert_retries must be >= 0, got "
                          f"{args.alert_retries}")
+    if args.canary_ticks < 0:
+        raise ValueError(f"--canary_ticks must be >= 0 (0 = off), got "
+                         f"{args.canary_ticks}")
+    fleet_slo.parse_slo_specs(args.slo)  # validate NOW, at the CLI surface
     alert_rules: list[dict] = []
     for raw in args.alert_rule:
         try:
@@ -2422,6 +2830,8 @@ def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
         alert_webhook=args.alert_webhook,
         alert_cmd=args.alert_cmd,
         alert_retries=args.alert_retries,
+        canary_ticks=args.canary_ticks,
+        slo=tuple(args.slo),
         quiet=args.quiet,
     )
 
@@ -2556,6 +2966,15 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
             # through, driving a full tenant_budget_burn firing ->
             # resolved cycle through the alert plane below.
             "tenant_budgets": {**cfg.tenant_budgets, "smokecost": 1e-4},
+            # The canary/SLO lane (ISSUE 18): a default objective per
+            # journey when the operator gave none, so the burn-rate
+            # rules register and the error-budget ledger runs.  Probe
+            # cadence stays OFF — the lane drives one round
+            # synchronously so the exactly-once deltas asserted above
+            # stay deterministic.
+            "slo": tuple(cfg.slo) or tuple(
+                f"{j}:0.99:64" for j in fleet_slo.JOURNEYS),
+            "canary_ticks": 0,
         }))
         router.start()
         jobs = {}
@@ -2863,6 +3282,47 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                 and camp_cost.get("avoided_device_s", 0.0) > 0
                 and (f'ict_campaign_device_seconds{{campaign="{camp_id}"}}'
                      in camp_metrics_text))
+            # --- the canary/SLO plane (ISSUE 18), end to end ---
+            # One synchronous probe round through the router's OWN HTTP
+            # surface (the background poll loop keeps driving campaign
+            # progress): every journey must come back green with a
+            # bit-identical mask verdict, the probes must provably
+            # never touch the capacity-demand, admission, or showback
+            # planes, and the --slo objectives injected above must have
+            # registered their multiwindow burn-rate rules.
+            demand_before = router.capacity.demand_total()
+            admit_before = router.metrics.counter_value(
+                "fleet_tenant_admissions_total",
+                {"tenant": SYNTHETIC_TENANT})
+            verdicts = {v["journey"]: v
+                        for v in router.canary.run_round()}
+            router.poll_tick()   # fold verdicts into the SLI gauges
+            canary_green = (
+                set(verdicts) == set(fleet_slo.CANARY_JOURNEYS)
+                and all(v.get("ok") and v.get("correct") is True
+                        for v in verdicts.values()))
+            canary_costs = json.load(urllib.request.urlopen(
+                f"{base}/fleet/costs", timeout=10))
+            synthetic_excluded = (
+                router.capacity.demand_total() == demand_before
+                and router.metrics.counter_value(
+                    "fleet_tenant_admissions_total",
+                    {"tenant": SYNTHETIC_TENANT}) == admit_before
+                and SYNTHETIC_TENANT
+                not in (canary_costs.get("tenants") or {}))
+            rule_names = {r["name"] for r in router.alerts.rules_table()}
+            burn_rules_ok = all(
+                f"slo_burn_fast:{j}" in rule_names
+                and f"slo_burn_slow:{j}" in rule_names
+                for j in fleet_slo.JOURNEYS)
+            slo_view = json.load(urllib.request.urlopen(
+                f"{base}/fleet/slo", timeout=10))
+            slo_report_ok = all(
+                (slo_view.get("journeys", {}).get(j, {})
+                 .get("availability") == 1.0)
+                for j in fleet_slo.CANARY_JOURNEYS)
+            canary_ok = (canary_green and synthetic_excluded
+                         and burn_rules_ok and slo_report_ok)
             # --- the cost-accounting plane (ISSUE 15), end to end ---
             # A tenant-tagged job burns through the injected tiny
             # budget; the costs lane then asserts (a) attribution
@@ -2954,7 +3414,7 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                   and done_delta == len(paths)
                   and fleet_ok and trace_ok and len(incidents) >= 1
                   and alerts_ok and coalesce_ok and cache_ok
-                  and campaign_ok and costs_ok
+                  and campaign_ok and canary_ok and costs_ok
                   and health_b.get("audits_run", 0) >= 1
                   and health_b.get("audit_divergences", 0) == 0)
             result = {
@@ -2984,6 +3444,12 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                 "campaign_cache_hits": int(camp_cache_hits),
                 "campaign_masks_ok": bool(camp_masks_ok),
                 "campaign_device_s": camp_cost.get("device_s"),
+                "canary_lane_ok": bool(canary_ok),
+                "canary_verdicts": {
+                    j: bool(v.get("ok")) for j, v in verdicts.items()},
+                "canary_synthetic_excluded": bool(synthetic_excluded),
+                "slo_burn_rules_ok": bool(burn_rules_ok),
+                "slo_tick": slo_view.get("tick"),
                 "costs_lane_ok": bool(costs_ok),
                 "cost_conservation_ratio": (
                     round(cost_sum / dispatch_sum, 4)

@@ -30,6 +30,13 @@ import threading
 
 DEFAULT_TENANT = "default"
 
+#: Reserved identity for the router's own synthetic canary traffic
+#: (fleet/canary.py).  Jobs under this tenant are stamped
+#: ``synthetic=true`` end-to-end and are excluded from capacity demand,
+#: tenant quotas, and cost showback — a probe that moved the planes it
+#: measures would be measuring itself.
+SYNTHETIC_TENANT = "_canary"
+
 
 class QuotaExceeded(RuntimeError):
     """Per-tenant open-placement cap reached (HTTP 429 + Retry-After)."""

@@ -140,13 +140,22 @@ def render_prometheus() -> str:
 
 
 def render_registries(counters: dict, gauges: dict,
-                      prefix: str = "ict_") -> str:
+                      prefix: str = "ict_", hists: dict | None = None,
+                      ) -> str:
     """Render plain ``{(family, ((label, value), ...)) -> float}`` counter
     and gauge registries as Prometheus text — the ONE implementation of
     the flat-registry exposition, shared by the fleet router's
     ``RouterMetrics`` (its registry is deliberately separate from the
     process-global one, but its *grammar* must not be a second
-    implementation)."""
+    implementation).
+
+    ``hists`` is the optional histogram table:
+    ``{(family, label_pairs) -> (bounds, per-bucket counts, sum)}`` with
+    ``len(counts) == len(bounds) + 1`` (the trailing slot is the +Inf
+    overflow).  Rendered as proper cumulative ``_bucket``/``_sum``/
+    ``_count`` samples (the render_prometheus phase-histogram grammar),
+    so :func:`bucket_cum` / :func:`quantile_from_cum` read them back —
+    the fleet SLO plane's per-journey latency quantiles ride this."""
     lines: list[str] = []
     for kind, table in (("counter", counters), ("gauge", gauges)):
         seen: set[str] = set()
@@ -156,6 +165,27 @@ def render_registries(counters: dict, gauges: dict,
                 lines.append(f"# TYPE {prefix}{family} {kind}")
             lines.append(f"{prefix}{family}{_labels(label_pairs)} "
                          f"{_fmt(table[(family, label_pairs)])}")
+    seen_h: set[str] = set()
+    for (family, label_pairs) in sorted(hists or {}):
+        bounds, buckets, total_sum = hists[(family, label_pairs)]
+        if family not in seen_h:
+            seen_h.add(family)
+            lines.append(f"# TYPE {prefix}{family} histogram")
+        cum = 0.0
+        for bound, n in zip(bounds, buckets):
+            cum += n
+            lines.append(f"{prefix}{family}_bucket"
+                         + _labels(tuple(label_pairs)
+                                   + (("le", repr(float(bound))),))
+                         + f" {_fmt(cum)}")
+        cum += buckets[-1]
+        lines.append(f"{prefix}{family}_bucket"
+                     + _labels(tuple(label_pairs) + (("le", "+Inf"),))
+                     + f" {_fmt(cum)}")
+        lines.append(f"{prefix}{family}_sum{_labels(label_pairs)} "
+                     f"{_fmt(total_sum)}")
+        lines.append(f"{prefix}{family}_count{_labels(label_pairs)} "
+                     f"{_fmt(cum)}")
     # Empty registries render as the empty exposition, not a lone "\n" —
     # a freshly started router's first scrape must still parse strictly.
     return "\n".join(lines) + "\n" if lines else ""

@@ -464,6 +464,18 @@ def run_soak(cfg: SoakConfig) -> int:
         rc = 0 if ok else 1
         fleet.verdict_code = 1.0 if ok else 2.0
         fleet.tick()   # final verdict visible on /fleet/metrics
+        # The SLI plane's view of the run (fleet/slo.py): the proving
+        # traffic drives the derived "admission" journey, so a soak that
+        # burned grant-waits shows up here even when the triad closed.
+        slo_report = fleet.router.slo.report()
+        adm = slo_report["journeys"].get("admission", {})
+        verdict.update({
+            "slo": {
+                "tick": slo_report["tick"],
+                "failing_journeys": slo_report["failing_journeys"],
+                "admission": {k: adm.get(k) for k in
+                              ("availability", "good", "bad")},
+            }})
         verdict.update({
             "prove": "pass" if ok else "fail",
             "triad": triad, "jobs": ledger,

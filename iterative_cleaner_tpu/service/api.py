@@ -305,6 +305,10 @@ class _Handler(BaseHTTPRequestHandler):
             audit = bool(body.get("audit", False))
             idem_key = str(body.get("idempotency_key", "") or "")
             tenant = str(body.get("tenant", "") or "")
+            # Router-injected canary probes (fleet/canary.py) stamp this;
+            # it rides the job record end-to-end so every observer can
+            # exclude synthetic traffic from the planes it measures.
+            synthetic = bool(body.get("synthetic", False))
             shape = body.get("shape")
             if shape is not None:
                 # Same optional grammar the fleet router accepts: the
@@ -333,7 +337,7 @@ class _Handler(BaseHTTPRequestHandler):
             job = service.submit(str(path), profile=profile, audit=audit,
                                  idempotency_key=idem_key,
                                  trace_id=trace_id, tenant=tenant,
-                                 shape=shape)
+                                 shape=shape, synthetic=synthetic)
         except ServiceBusy as exc:
             self._reply(503, {"error": str(exc)}, headers={"Retry-After": "5"})
             return

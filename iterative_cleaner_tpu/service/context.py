@@ -315,7 +315,7 @@ class ReplicaContext:
 
     def new_job(self, path: str, profile: bool = False, audit: bool = False,
                 idempotency_key: str = "", trace_id: str = "",
-                tenant: str = "") -> Job:
+                tenant: str = "", synthetic: bool = False) -> Job:
         """Mint one job record.  The trace context is minted HERE unless
         the submitter carried one across the router hop (X-ICT-Trace) —
         either way it rides the job through every layer and is echoed in
@@ -326,4 +326,5 @@ class ReplicaContext:
         return Job(id=new_job_id(), path=path, submitted_s=time.time(),
                    trace_id=trace_id or events.new_trace_id(),
                    profile=bool(profile), audit=bool(audit),
-                   idem_key=idempotency_key, tenant=tenant)
+                   idem_key=idempotency_key, tenant=tenant,
+                   synthetic=bool(synthetic))

@@ -386,7 +386,8 @@ class CleaningService:
     def submit(self, path: str, profile: bool = False,
                audit: bool = False, idempotency_key: str = "",
                trace_id: str = "", tenant: str = "",
-               shape: list | tuple | None = None) -> Job:
+               shape: list | tuple | None = None,
+               synthetic: bool = False) -> Job:
         # A draining replica accepts no NEW work (503; the router reads the
         # same flag off /healthz and stops placing here) — already-accepted
         # jobs keep running to completion (docs/SERVING.md "Fleet").
@@ -415,7 +416,8 @@ class CleaningService:
         # (obs/audit; ICT_AUDIT_RATE / --audit_rate samples the rest).
         job = self.ctx.new_job(path, profile=profile, audit=audit,
                                idempotency_key=idempotency_key,
-                               trace_id=trace_id, tenant=tenant)
+                               trace_id=trace_id, tenant=tenant,
+                               synthetic=synthetic)
         dup_id = self.ctx.admit(job, idempotency_key)
         if dup_id is not None:
             # Lost an admission race on the same key: serve the winner.

@@ -80,6 +80,10 @@ class Job:
                                     # field; "" reads as "default") — the
                                     # cost ledger's aggregation key
                                     # (obs/costs.py)
+    synthetic: bool = False         # router-injected canary probe
+                                    # (fleet/canary.py): stamped end-to-
+                                    # end so every observer can exclude
+                                    # it from demand/quota/cost planes
     # Cost accounting (obs/costs.py): device-seconds split by phase,
     # compile seconds, apportioned static bytes/FLOPs, coalesced batch
     # size, cache-hit avoided cost, attainment — stamped by the dispatch

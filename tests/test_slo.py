@@ -1,0 +1,540 @@
+"""ISSUE 18 end to end: the black-box canary prober + SLI/error-budget
+plane.
+
+Units drive the SloPlane ledger directly (tick-driven, no wall clock);
+the e2e tests stand up a 2-replica in-process fleet and run real probe
+rounds through the router's public HTTP surface — every journey must
+come back green with a bit-identical mask verdict, synthetic traffic
+must provably never move the capacity-demand / admission / showback
+planes, and an injected single-bit mask flip must propagate
+canary -> correctness SLI -> burn alert -> incident bundle.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from test_fleet import (
+    _get,
+    _post_job,
+    _start_replica,
+    _start_router,
+)
+from iterative_cleaner_tpu.config import CleanConfig
+from iterative_cleaner_tpu.fleet import canary as fleet_canary
+from iterative_cleaner_tpu.fleet import obs as fleet_obs
+from iterative_cleaner_tpu.fleet import slo as fleet_slo
+from iterative_cleaner_tpu.fleet.tenants import SYNTHETIC_TENANT
+from iterative_cleaner_tpu.obs import metrics as obs_metrics
+
+
+# --- units: spec grammar ---
+
+
+class TestSloSpecParsing:
+    def test_valid_specs_parse(self):
+        objs = fleet_slo.parse_slo_specs(
+            ["fresh:0.99:64", "admission:0.999:512"])
+        assert objs["fresh"].target == 0.99
+        assert objs["fresh"].window_ticks == 64
+        assert objs["fresh"].fast_window == 8
+        assert objs["admission"].fast_window == 64
+
+    def test_fast_window_floors_at_one_tick(self):
+        assert fleet_slo.parse_slo_specs(
+            ["cache:0.9:4"])["cache"].fast_window == 1
+
+    @pytest.mark.parametrize("spec", [
+        "fresh:0.99",                 # arity
+        "fresh:0.99:64:extra",        # arity
+        "teleport:0.99:64",           # unknown journey
+        "fresh:0:64",                 # target lower bound
+        "fresh:1.5:64",               # target upper bound
+        "fresh:nope:64",              # non-float target
+        "fresh:0.99:0",               # window floor
+        "fresh:0.99:ten",             # non-int window
+    ])
+    def test_bad_specs_raise(self, spec):
+        with pytest.raises(ValueError):
+            fleet_slo.parse_slo_specs([spec])
+
+    def test_duplicate_journey_raises(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            fleet_slo.parse_slo_specs(["fresh:0.9:8", "fresh:0.99:64"])
+
+
+class TestBurnRules:
+    def test_two_rules_per_objective(self):
+        rules = fleet_slo.burn_rules(
+            fleet_slo.parse_slo_specs(["fresh:0.99:64", "cache:0.9:8"]))
+        by_name = {r.name: r for r in rules}
+        assert set(by_name) == {"slo_burn_fast:fresh", "slo_burn_slow:fresh",
+                                "slo_burn_fast:cache", "slo_burn_slow:cache"}
+        fast = by_name["slo_burn_fast:fresh"]
+        assert fast.severity == "critical"
+        assert fast.family == "ict_sli_burn_rate"
+        assert fast.source == "slo"
+        assert dict(fast.labels) == {"journey": "fresh", "window": "fast"}
+        slow = by_name["slo_burn_slow:fresh"]
+        assert slow.severity == "warning"
+        assert dict(slow.labels) == {"journey": "fresh", "window": "slow"}
+
+
+# --- units: the ledger math, tick-driven ---
+
+
+def _plane(tmp_path, specs=()):
+    return fleet_slo.SloPlane(
+        fleet_slo.parse_slo_specs(specs), str(tmp_path))
+
+
+def _verdict(journey, ok=True, correct=True, latency=0.1, **extra):
+    return {"journey": journey, "ok": ok, "correct": correct,
+            "latency_s": latency, **extra}
+
+
+class TestSloPlaneMath:
+    def test_green_verdicts_keep_full_budget(self, tmp_path):
+        p = _plane(tmp_path, ["fresh:0.99:64"])
+        for _ in range(5):
+            p.note_verdict(_verdict("fresh"))
+            p.end_tick()
+        row = p.report()["journeys"]["fresh"]
+        assert row["availability"] == 1.0
+        assert row["correctness"] == 1.0
+        assert row["burn"] == {"fast": 0.0, "slow": 0.0}
+        assert row["budget_remaining_pct"] == 100.0
+        assert p.min_budget_remaining() == 100.0
+        assert p.failing_journeys() == []
+
+    def test_burn_rate_math_is_exact(self, tmp_path):
+        # target 0.9 -> allowance 0.1; one bad of two events -> bad_frac
+        # 0.5 -> burn 5.0 on both windows; budget clamps at 0.
+        p = _plane(tmp_path, ["fresh:0.9:8"])
+        p.note_verdict(_verdict("fresh", ok=True))
+        p.note_verdict(_verdict("fresh", ok=False, correct=None))
+        p.end_tick()
+        row = p.report()["journeys"]["fresh"]
+        assert row["burn"]["slow"] == pytest.approx(5.0)
+        assert row["burn"]["fast"] == pytest.approx(5.0)
+        assert row["budget_remaining_pct"] == 0.0
+        assert row["availability"] == pytest.approx(0.5)
+
+    def test_open_tick_events_count_immediately(self, tmp_path):
+        # A verdict must move the SLIs THIS tick, before end_tick.
+        p = _plane(tmp_path, ["fresh:0.9:8"])
+        p.note_verdict(_verdict("fresh", ok=False, correct=False))
+        row = p.report()["journeys"]["fresh"]
+        assert row["availability"] == 0.0
+        assert row["correctness"] == 0.0
+        assert p.failing_journeys() == ["fresh"]
+
+    def test_bad_tick_rolls_out_of_the_window(self, tmp_path):
+        # One all-bad tick, then a window of all-good ticks: the slow
+        # burn must decay back to 0 once the bad tick leaves the ring.
+        p = _plane(tmp_path, ["fresh:0.5:4"])
+        p.note_verdict(_verdict("fresh", ok=False, correct=None))
+        p.end_tick()
+        assert p.report()["journeys"]["fresh"]["burn"]["slow"] > 0
+        for _ in range(4):
+            p.note_verdict(_verdict("fresh"))
+            p.end_tick()
+        row = p.report()["journeys"]["fresh"]
+        assert row["burn"]["slow"] == 0.0
+        assert row["budget_remaining_pct"] == 100.0
+
+    def test_fast_window_sees_cliff_before_slow_window_drains(self,
+                                                              tmp_path):
+        # 62 good ticks then 2 all-bad ticks: the fast (8-tick) window
+        # burns far hotter than the slow (64-tick) one — the multiwindow
+        # shape that pages on a cliff.
+        p = _plane(tmp_path, ["fresh:0.99:64"])
+        for _ in range(62):
+            p.note_verdict(_verdict("fresh"))
+            p.end_tick()
+        for _ in range(2):
+            p.note_verdict(_verdict("fresh", ok=False, correct=None))
+            p.end_tick()
+        row = p.report()["journeys"]["fresh"]
+        assert row["burn"]["fast"] > fleet_slo.FAST_BURN
+        assert row["burn"]["fast"] > row["burn"]["slow"]
+
+    def test_admission_fold_and_counter_rebase(self, tmp_path):
+        p = _plane(tmp_path, ["admission:0.9:8"])
+        p.note_admission(burned_total=2.0, placed_total=10.0)
+        p.end_tick()
+        row = p.report()["journeys"]["admission"]
+        assert row["good"] == 8.0 and row["bad"] == 2.0
+        # A backwards jump (router restart zeroed its counters) re-bases
+        # instead of producing negative deltas.
+        p.note_admission(burned_total=1.0, placed_total=3.0)
+        p.end_tick()
+        row = p.report()["journeys"]["admission"]
+        assert row["good"] == 10.0 and row["bad"] == 3.0
+
+    def test_latency_quantiles_come_from_log2_buckets(self, tmp_path):
+        p = _plane(tmp_path)
+        for lat in (0.01, 0.01, 0.01, 10.0):
+            p.note_verdict(_verdict("fresh", latency=lat))
+        row = p.report()["journeys"]["fresh"]
+        # p50 lands in the 0.01 bucket's bound, p99 in 10.0's.
+        assert row["latency_p50_s"] <= 0.015625
+        assert row["latency_p99_s"] >= 10.0
+
+    def test_no_objectives_means_no_budget(self, tmp_path):
+        p = _plane(tmp_path)
+        assert p.min_budget_remaining() is None
+        row = p.report()["journeys"]["fresh"]
+        assert "budget_remaining_pct" not in row
+
+
+class TestLedgerPersistence:
+    def test_restart_rehydrates_the_budget(self, tmp_path):
+        p = _plane(tmp_path, ["fresh:0.9:8"])
+        p.note_verdict(_verdict("fresh"))
+        p.note_verdict(_verdict("fresh", ok=False, correct=False))
+        for _ in range(3):
+            p.end_tick()
+        before = p.report()["journeys"]["fresh"]
+        assert os.path.exists(
+            str(tmp_path / "slo" / fleet_slo.LEDGER_FILE))
+        # A fresh plane over the same spool resumes the accounting
+        # instead of refilling the budget to 100%.
+        p2 = _plane(tmp_path, ["fresh:0.9:8"])
+        after = p2.report()["journeys"]["fresh"]
+        assert p2.report()["tick"] == 3
+        for key in ("availability", "correctness", "good", "bad",
+                    "budget_remaining_pct", "burn"):
+            assert after[key] == before[key], key
+        assert p2.failing_journeys() == ["fresh"]
+
+    def test_torn_ledger_restarts_clean(self, tmp_path):
+        p = _plane(tmp_path, ["fresh:0.9:8"])
+        p.note_verdict(_verdict("fresh"))
+        p.end_tick()
+        path = str(tmp_path / "slo" / fleet_slo.LEDGER_FILE)
+        with open(path, "w") as fh:
+            fh.write('{"tick": 1, "journeys": {"fresh"')   # torn write
+        p2 = _plane(tmp_path, ["fresh:0.9:8"])
+        assert p2.report()["tick"] == 0
+
+    def test_part_files_swept_on_rehydrate(self, tmp_path):
+        p = _plane(tmp_path)
+        part = str(tmp_path / "slo" / (fleet_slo.LEDGER_FILE + ".part"))
+        with open(part, "w") as fh:
+            fh.write("{")
+        _plane(tmp_path)
+        assert not os.path.exists(part)
+        del p
+
+
+# --- e2e: probe rounds against a real 2-replica fleet ---
+
+
+CANARY_SLO = tuple(f"{j}:0.99:64" for j in fleet_slo.CANARY_JOURNEYS)
+
+
+@pytest.fixture(scope="class")
+def canary_fleet(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("canary_fleet")
+    svc_a = _start_replica(tmp_path, "can-a", deadline_s=0.2)
+    svc_b = _start_replica(tmp_path, "can-b", deadline_s=0.2)
+    # A LIVE poll loop (unlike the dormant test_fleet default): the
+    # campaign journey's placements are driven by _campaign_tick, so a
+    # synchronous run_round needs the loop turning underneath it.
+    router = _start_router(svc_a, svc_b, poll_interval_s=0.1,
+                           slo=CANARY_SLO)
+    # The oracle must be computed under the replicas' cleaning config
+    # (max_iter=3 in the test harness, not the default).
+    router.canary.clean_cfg = CleanConfig(
+        backend="numpy", max_iter=3, quiet=True, no_log=True)
+    try:
+        yield router, svc_a, svc_b
+    finally:
+        router.stop()
+        svc_a.stop()
+        svc_b.stop()
+
+
+@pytest.mark.usefixtures("canary_fleet")
+class TestCanaryEndToEnd:
+    def test_a_full_round_is_green_and_synthetic_is_excluded(
+            self, canary_fleet):
+        router, svc_a, svc_b = canary_fleet
+        demand_before = router.capacity.demand_total()
+        admit_before = router.metrics.counter_value(
+            "fleet_tenant_admissions_total", {"tenant": SYNTHETIC_TENANT})
+
+        verdicts = {v["journey"]: v for v in router.canary.run_round()}
+
+        # Every user journey green, every mask bit-identical.
+        assert set(verdicts) == set(fleet_slo.CANARY_JOURNEYS)
+        for j, v in verdicts.items():
+            assert v["ok"], (j, v)
+            assert v["correct"] is True, (j, v)
+        # The cache journey's contract is the reuse tier itself.
+        assert verdicts["cache"]["cache_hit"] is True
+        assert verdicts["session"]["blocks"] == 4
+        assert verdicts["campaign"]["archives"] == 2
+
+        # Synthetic exclusion, asserted against every plane the probes
+        # must not move: capacity demand, tenant admission, showback.
+        assert router.capacity.demand_total() == demand_before
+        assert router.metrics.counter_value(
+            "fleet_tenant_admissions_total",
+            {"tenant": SYNTHETIC_TENANT}) == admit_before == 0.0
+        router.poll_tick()
+        costs = _get(router, "/fleet/costs")
+        assert SYNTHETIC_TENANT not in (costs.get("tenants") or {})
+        # ...and no admission slot leaked: synthetic placements skip the
+        # grant plane symmetrically on the terminal transition.
+        assert router.admission.open_count(SYNTHETIC_TENANT) == 0
+
+        # The verdicts surfaced on the SLI plane and GET /fleet/slo.
+        slo_view = _get(router, "/fleet/slo")
+        for j in fleet_slo.CANARY_JOURNEYS:
+            row = slo_view["journeys"][j]
+            assert row["availability"] == 1.0
+            assert row["correctness"] == 1.0
+            assert row["budget_remaining_pct"] == 100.0
+        assert slo_view["failing_journeys"] == []
+        assert slo_view["scale_down_veto"] is False
+
+    def test_b_per_hop_latency_rides_the_trace(self, canary_fleet):
+        router, _svc_a, _svc_b = canary_fleet
+        last = _get(router, "/fleet/slo")["journeys"]["fresh"][
+            "last_verdict"]
+        assert last["trace_id"]
+        trace = _get(router, f"/fleet/trace/{last['trace_id']}")
+        hops = fleet_obs.span_hops(trace.get("spans") or [])
+        assert last["hops"] == hops
+        assert last["hops"], "fresh verdict carried no per-hop latency"
+
+    def test_b2_fleet_top_renders_the_slo_section(self, canary_fleet,
+                                                  capsys):
+        # The operator view (satellite a): fleet_top's SLO/CANARY
+        # section off GET /fleet/slo, one row per journey.
+        router, _svc_a, _svc_b = canary_fleet
+        import importlib.util
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "fleet_top", os.path.join(repo, "tools", "fleet_top.py"))
+        fleet_top = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(fleet_top)
+        assert fleet_top.main(
+            ["--router", f"http://127.0.0.1:{router.port}"]) == 0
+        table = capsys.readouterr().out
+        assert "SLO" in table and "JOURNEY" in table
+        for j in fleet_slo.CANARY_JOURNEYS:
+            assert j in table
+
+    def test_c_metrics_families_render_under_the_strict_grammar(
+            self, canary_fleet):
+        router, _svc_a, _svc_b = canary_fleet
+        text = router.metrics.render()
+        fams = {f.name: f for f in obs_metrics.parse_exposition(text)}
+        for name in ("ict_sli_availability", "ict_sli_correctness",
+                     "ict_sli_latency_p50_seconds",
+                     "ict_sli_latency_p99_seconds",
+                     "ict_sli_error_budget_remaining_pct",
+                     "ict_sli_burn_rate", "ict_sli_good_events_total",
+                     "ict_sli_bad_events_total", "ict_canary_probes_total",
+                     "ict_canary_mask_mismatches_total",
+                     "ict_canary_journey_seconds"):
+            assert name in fams, name
+        assert fams["ict_canary_journey_seconds"].kind == "histogram"
+        # One green probe per canary journey counted under outcome=ok.
+        ok_counts = {
+            dict(labels)["journey"]: obs_metrics.sample_value(raw)
+            for _n, labels, raw in fams["ict_canary_probes_total"].samples
+            if dict(labels).get("outcome") == "ok"}
+        for j in fleet_slo.CANARY_JOURNEYS:
+            assert ok_counts[j] >= 1.0, j
+
+    def test_d_admission_journey_folds_the_pr10_counters(
+            self, canary_fleet, tmp_path):
+        # The drift pin for the ISSUE 18 satellite: the old
+        # ict_fleet_slo_burn_total family keeps rendering AND its totals
+        # fold into the new SLI grammar as the admission journey.
+        router, _svc_a, _svc_b = canary_fleet
+        from iterative_cleaner_tpu.io.npz import NpzIO
+        from iterative_cleaner_tpu.io.synthetic import make_archive
+
+        paths = []
+        for i in range(2):
+            p = str(tmp_path / f"adm{i}.npz")
+            NpzIO().save(make_archive(nsub=4, nchan=16, nbin=64,
+                                      seed=41 + i), p)
+            paths.append(p)
+        bad_before = router.metrics.counter_value(
+            "sli_bad_events_total", {"journey": "admission"})
+        before = _get(router, "/fleet/slo")["journeys"]["admission"]
+        # Two real placements, one injected grant-wait burn: the fold
+        # books bad = burn delta, good = placements - bad.
+        for p in paths:
+            _post_job(router, {"path": p, "shape": [4, 16, 64]})
+        router.metrics.count("fleet_slo_burn_total",
+                             {"tenant": "default"}, 1.0)
+        router.poll_tick()
+        row = _get(router, "/fleet/slo")["journeys"]["admission"]
+        assert row["bad"] - before["bad"] >= 1.0
+        assert row["good"] - before["good"] >= 1.0
+        assert router.metrics.counter_value(
+            "sli_bad_events_total",
+            {"journey": "admission"}) >= bad_before + 1.0
+        text = router.metrics.render()
+        assert "ict_fleet_slo_burn_total" in text   # old family renders
+        assert 'ict_sli_bad_events_total{journey="admission"}' in text
+
+    def test_e_mask_corruption_propagates_to_alert_and_incident(
+            self, canary_fleet):
+        import time as _time
+
+        router, _svc_a, _svc_b = canary_fleet
+        mm_before = router.metrics.counter_value(
+            "canary_mask_mismatches_total", {"journey": "fresh"})
+        router.canary.corrupt_mask = True
+        try:
+            verdicts = {v["journey"]: v for v in router.canary.run_round()}
+        finally:
+            router.canary.corrupt_mask = False
+        for j in fleet_slo.CANARY_JOURNEYS:
+            assert verdicts[j]["correct"] is False, j
+            assert not verdicts[j]["ok"], j
+        assert router.metrics.counter_value(
+            "canary_mask_mismatches_total",
+            {"journey": "fresh"}) == mm_before + 1.0
+
+        # correctness SLI drops on the next fold...
+        router.poll_tick()
+        slo_view = _get(router, "/fleet/slo")
+        assert slo_view["journeys"]["fresh"]["correctness"] < 1.0
+        assert set(slo_view["failing_journeys"]) == set(
+            fleet_slo.CANARY_JOURNEYS)
+        # ...the 0.99 objective's burn blows both thresholds
+        # (bad_frac/(1-0.99) >> 8) and the auto-registered rules fire...
+        deadline = _time.time() + 30
+        firing = []
+        while _time.time() < deadline:
+            router.poll_tick()
+            firing = [a["rule"] for a in router.alerts.firing()]
+            if "slo_burn_fast:fresh" in firing:
+                break
+            _time.sleep(0.05)
+        assert "slo_burn_fast:fresh" in firing
+        assert "slo_burn_slow:fresh" in firing
+        # ...and the mismatch landed an incident bundle on disk.
+        incidents = fleet_obs.list_incidents(router.incident_dir)
+        mism = [i for i in incidents
+                if i.get("reason") == "canary_mask_mismatch"]
+        assert mism, incidents
+        assert router.metrics.counter_value(
+            "fleet_incidents_total",
+            {"reason": "canary_mask_mismatch"}) >= 1.0
+
+    def test_f_recovery_restores_the_journeys(self, canary_fleet):
+        router, _svc_a, _svc_b = canary_fleet
+        verdicts = {v["journey"]: v for v in router.canary.run_round()}
+        assert all(v["ok"] for v in verdicts.values()), verdicts
+        router.poll_tick()
+        assert _get(router, "/fleet/slo")["failing_journeys"] == []
+
+    def test_g_unknown_session_404s_through_the_proxy(self, canary_fleet):
+        router, _svc_a, _svc_b = canary_fleet
+        assert _get(router, "/sessions/nope", expect_error=True) == 404
+
+
+class TestScaleDownVeto:
+    def test_veto_semantics(self, tmp_path):
+        svc = _start_replica(tmp_path, "veto-a")
+        router = _start_router(svc, slo=("fresh:0.99:64",))
+        try:
+            import types
+
+            # Autoscale is off in this router, so stand in for the
+            # supervisor the acted-autoscale path would own.
+            url = f"http://127.0.0.1:{svc.port}"
+            router.supervisor = types.SimpleNamespace(
+                up_urls=lambda: {url: "managed-1"},
+                stop_all=lambda: None)
+            # No failing journey -> no veto.
+            assert router._canary_scale_veto("managed-1") == ""
+            router.slo.note_verdict(_verdict("fresh", ok=False,
+                                             correct=False))
+            router.poll_tick()
+            # Failing journey + the victim is the only replica that
+            # could serve the canary bucket -> veto, with the journey
+            # named in the reason.
+            veto = router._canary_scale_veto("managed-1")
+            assert "fresh" in veto and "vetoed" in veto
+            # The budget state rides the autoscaler's decision signals.
+            assert router.slo.min_budget_remaining() is not None
+        finally:
+            router.stop()
+            svc.stop()
+
+    def test_other_warm_replica_lifts_the_veto(self, tmp_path):
+        svc_a = _start_replica(tmp_path, "warm-a")
+        svc_b = _start_replica(tmp_path, "warm-b")
+        router = _start_router(svc_a, svc_b, poll_interval_s=0.1,
+                               slo=("fresh:0.99:64",))
+        router.canary.clean_cfg = CleanConfig(
+            backend="numpy", max_iter=3, quiet=True, no_log=True)
+        try:
+            # Warm both replicas for the canary bucket with a real round.
+            verdicts = router.canary.run_round()
+            assert all(v["ok"] for v in verdicts), verdicts
+            router.slo.note_verdict(_verdict("fresh", ok=False,
+                                             correct=False))
+            import types
+
+            by_url = {f"http://127.0.0.1:{s.port}": f"m-{s.port}"
+                      for s in (svc_a, svc_b)}
+            router.supervisor = types.SimpleNamespace(
+                up_urls=lambda: dict(by_url), stop_all=lambda: None)
+            router.registry.poll_once(router.client)
+            vetoes = [router._canary_scale_veto(mid)
+                      for mid in by_url.values()]
+            # At least one replica is warm for (4,16,64) after the
+            # round, so draining the OTHER one must not be vetoed.
+            assert "" in vetoes
+        finally:
+            router.stop()
+            svc_a.stop()
+            svc_b.stop()
+
+
+class TestCanaryCorpus:
+    def test_fresh_file_changes_bytes_not_mask(self, tmp_path):
+        prober = fleet_canary.CanaryProber(
+            str(tmp_path), lambda: "http://127.0.0.1:1")
+        prober._ensure_prepared()
+        # The fresh file is rewritten in place with a new nonce each
+        # round: new bytes (new fleet-cache digest), same oracle mask.
+        import shutil
+        keep = str(tmp_path / "keep.npz")
+        shutil.copy(prober._fresh_file(), keep)
+        p3 = prober._fresh_file()
+        with open(keep, "rb") as f1, open(p3, "rb") as f2:
+            assert f1.read() != f2.read()
+        # The oracle mask is invariant under the re-stamp: the nonce
+        # lives in metadata the cleaner never reads.
+        assert np.array_equal(prober._oracle(p3), prober._oracle_a)
+
+    def test_journey_failure_becomes_a_verdict_not_a_crash(self,
+                                                           tmp_path):
+        # No router behind the base URL: all four journeys must come
+        # back as failed verdicts, not exceptions.
+        prober = fleet_canary.CanaryProber(
+            str(tmp_path), lambda: "http://127.0.0.1:9",
+            timeout_s=2.0)
+        verdicts = prober.run_round()
+        assert [v["journey"] for v in verdicts] == list(
+            fleet_slo.CANARY_JOURNEYS)
+        assert all(not v["ok"] and v["error"] for v in verdicts)
+        assert prober.rounds() == 1

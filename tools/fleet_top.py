@@ -12,7 +12,11 @@ CAMPAIGNS section off the survey orchestrator (per-campaign archive
 progress and device-seconds), a TENANTS showback section off the cost
 plane (device-seconds, jobs, cache savings, budget burn), a SOAK
 section off the proving ground's ``ict_prove_*`` gauges when an
-``ict-clean prove`` soak is driving the router (docs/PROVING.md), and a
+``ict-clean prove`` soak is driving the router (docs/PROVING.md), an
+SLO section off the SLI/error-budget plane (``GET /fleet/slo``:
+per-journey availability/correctness, p99 latency, budget remaining,
+burn rates, and the canary prober's round count — docs/OBSERVABILITY.md
+"Canary probing & SLOs"), and a
 FIRING ALERTS section off the alerting plane.  ``--json`` prints the same snapshot as ONE JSON line
 for scripting (the bench.py one-line contract); ``--watch N``
 re-renders every N seconds until interrupted (one JSON line per
@@ -65,6 +69,10 @@ def collect(base: str, timeout_s: float = 10.0) -> dict:
         costs = _get_json(base, "/fleet/costs", timeout_s)
     except (urllib.error.URLError, OSError, ValueError):
         costs = {}    # pre-costs routers still render everything else
+    try:
+        slo = _get_json(base, "/fleet/slo", timeout_s)
+    except (urllib.error.URLError, OSError, ValueError):
+        slo = {}      # pre-SLO routers still render everything else
     p50s: dict[str, float] = {}
     scale_events = 0.0
     # bucket -> {k -> dispatch count} (the merged fleet-wide coalesce
@@ -135,6 +143,7 @@ def collect(base: str, timeout_s: float = 10.0) -> dict:
                             for b, counts in cache_counts.items()},
         "fleet_cache": health.get("result_cache") or {},
         "campaigns": health.get("campaigns") or {},
+        "slo": slo,
         "soak": ({"scenarios": soak_scenarios, "faults": soak_faults,
                   "verdict": soak_verdict,
                   "sink_degraded": soak_sink_degraded}
@@ -238,6 +247,7 @@ def render(snap: dict) -> str:
     lines += render_campaigns(snap.get("campaigns") or {})
     lines += render_tenants(snap.get("costs") or {})
     lines += render_soak(snap.get("soak") or {})
+    lines += render_slo(snap.get("slo") or {})
     fleet = capacity.get("fleet", {})
     if fleet:
         fc = snap.get("fleet_cache") or {}
@@ -356,6 +366,40 @@ def render_soak(soak: dict) -> list[str]:
             rec = faults[name]
             lines.append(f"{name:<22} {_fmt_num(rec.get('injected')):>9} "
                          f"{_fmt_num(rec.get('healed')):>7}")
+    return lines
+
+
+def render_slo(slo: dict) -> list[str]:
+    """The SLO section (from ``GET /fleet/slo``): one row per journey —
+    availability, correctness, p99 latency, and (for journeys with a
+    declared ``--slo`` objective) the target, budget remaining, and
+    fast/slow burn rates.  The header carries the canary prober's state
+    and any journeys currently vetoing scale-down.  Empty (section
+    absent) when the router predates the SLO plane."""
+    journeys = slo.get("journeys") or {}
+    if not journeys:
+        return []
+    canary = slo.get("canary") or {}
+    failing = slo.get("failing_journeys") or []
+    head = ("SLO  (canary="
+            + ("off" if not canary.get("enabled")
+               else f"every {_fmt_num(canary.get('cadence_ticks'))} ticks, "
+                    f"{_fmt_num(canary.get('rounds'))} rounds")
+            + (f"  FAILING: {','.join(failing)}" if failing else "") + ")")
+    lines = ["", head,
+             f"{'JOURNEY':<10} {'AVAIL':>7} {'CORRECT':>8} {'P99_S':>8} "
+             f"{'TARGET':>7} {'BUDGET%':>8} {'BURN_F':>7} {'BURN_S':>7}"]
+    for name in sorted(journeys):
+        rec = journeys[name]
+        burn = rec.get("burn") or {}
+        lines.append(
+            f"{name:<10} {_fmt_num(rec.get('availability')):>7} "
+            f"{_fmt_num(rec.get('correctness')):>8} "
+            f"{_fmt_num(rec.get('latency_p99_s')):>8} "
+            f"{_fmt_num(rec.get('target')):>7} "
+            f"{_fmt_num(rec.get('budget_remaining_pct')):>8} "
+            f"{_fmt_num(burn.get('fast')):>7} "
+            f"{_fmt_num(burn.get('slow')):>7}")
     return lines
 
 
