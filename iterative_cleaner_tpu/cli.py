@@ -238,6 +238,14 @@ def main(argv: list[str] | None = None) -> int:
         from iterative_cleaner_tpu.proving.soak import prove_main
 
         return prove_main(argv[1:])
+    if argv and argv[0] == "explain" and not os.path.isfile("explain"):
+        # The per-job explain plane: fetch GET /fleet/explain/<job_id>
+        # from a fleet router and render the seven-plane causal report
+        # (docs/OBSERVABILITY.md "Production recorder & explain plane");
+        # same literal-token dispatch rule as ``serve``.
+        from iterative_cleaner_tpu.fleet.explain import explain_main
+
+        return explain_main(argv[1:])
     if argv and argv[0] == "serve-fleet" and not os.path.isfile("serve-fleet"):
         # The fleet router in front of N daemon replicas (docs/SERVING.md
         # "Fleet"); same literal-token dispatch rule as ``serve``, and

@@ -74,9 +74,11 @@ from iterative_cleaner_tpu.fleet.tenants import (
     TenantAdmission,
     WeightedFairQueue,
 )
+from iterative_cleaner_tpu.fleet import explain as fleet_explain
 from iterative_cleaner_tpu.obs import events, flight
 from iterative_cleaner_tpu.obs import metrics as obs_metrics
 from iterative_cleaner_tpu.obs import tracing as obs_tracing
+from iterative_cleaner_tpu.proving import recorder as fleet_recorder
 from iterative_cleaner_tpu.service.scheduler import bucket_label
 from iterative_cleaner_tpu.utils import backoff
 
@@ -193,6 +195,12 @@ class FleetConfig:
     slo: tuple = ()                  # declarative SLO objective specs
                                      # (--slo JOURNEY:TARGET:WINDOW_TICKS;
                                      # fleet/slo.py)
+    recorder: bool = True            # the production flight recorder
+                                     # (proving/recorder.py): always on
+                                     # unless --no_recorder / ICT_RECORDER=0
+    recorder_segment_kb: int = 256   # open-segment size cap before a
+                                     # seal rotates it
+    recorder_keep: int = 16          # sealed segments retained
     quiet: bool = False
 
 
@@ -560,6 +568,30 @@ class FleetRouter:
                                {"journey": j}, inc=0.0)
             self.metrics.ensure_hist(fleet_slo.CANARY_HIST_FAMILY,
                                      {"journey": j})
+        # The production flight recorder (proving/recorder.py; ISSUE 19):
+        # every REAL submission lands on a bounded, rotated segment set
+        # under <spool>/fleet-traces in the proving-ground trace grammar
+        # — synthetic canary/soak traffic is refused inside record(), by
+        # construction, and ICT_RECORDER=0 (or --no_recorder) disables
+        # recording while keeping the read surface live.  Its lock sits
+        # strictly after the router's, file appends only, never HTTP.
+        self.recorder = fleet_recorder.FlightRecorder(
+            os.path.join(cfg.spool_dir, "fleet-traces"),
+            max_segment_kb=cfg.recorder_segment_kb,
+            keep=cfg.recorder_keep,
+            enabled=(cfg.recorder
+                     and os.environ.get("ICT_RECORDER", "1") != "0"),
+            quiet=cfg.quiet)
+        # Counter mirrors are delta-fed from the recorder's own totals
+        # once per poll tick (_recorder_tick); the whole ict_recorder_*
+        # surface is pre-registered at zero NOW (the budget-gauge
+        # lesson) so every documented family is live on the first scrape.
+        self._recorder_seen: dict = {}  # ict: guarded-by(self._lock)
+        for fam in ("recorder_entries_total", "recorder_excluded_total",
+                    "recorder_dropped_total",
+                    "recorder_segments_sealed_total"):
+            self.metrics.count(fam, inc=0.0)
+        self._recorder_tick()
         # Streaming-session proxy routes: fleet session id -> (replica
         # base_url, trace_id), bounded FIFO so an abandoned session can
         # never grow the map without bound.
@@ -689,6 +721,7 @@ class FleetRouter:
         self._update_costs()
         self._campaign_tick()
         self._slo_tick()
+        self._recorder_tick()
         self._autoscale_tick()
         self._history_alert_tick()
         self._trim_placements()
@@ -1582,6 +1615,14 @@ class FleetRouter:
                         idem_key=key,
                         shape=[int(v) for v in shape],
                         cache_salt=salt)
+        # Recorder hook, cache half: a born-terminal hit never reaches a
+        # replica's job_submitted, so this is its ONLY tape entry
+        # (entry="cache", the grammar's cache-served marker).
+        self.recorder.record(
+            path=str(payload.get("path", "") or ""), tenant=tenant,
+            idem_key=key, shape=tuple(shape), bucket=self._bucket_of(payload),
+            salt=salt, trace_id=trace_id, entry="cache",
+            synthetic=bool(payload.get("synthetic")))
         # Deliberately NOT counted in fleet_jobs_completed_total: that
         # counter is the exactly-once ledger of placements the fleet
         # actually ran, and the smoke/tests pin it against replica-side
@@ -1668,6 +1709,16 @@ class FleetRouter:
                         replica_id=rep.replica_id, tenant=tenant,
                         bucket=self._bucket_of(payload),
                         idem_key=key)
+        # The production flight recorder's fresh-placement hook: one
+        # entry per real submission, as it happens (synthetic probes are
+        # refused inside record(), by construction; failover re-routes
+        # and idempotent dedupes never reach here, so each arrival is
+        # recorded exactly once — the record_trace dedupe, live).
+        self.recorder.record(
+            path=str(payload.get("path", "") or ""), tenant=tenant,
+            idem_key=key, shape=tuple(payload.get("shape") or ()),
+            bucket=self._bucket_of(payload), trace_id=trace_id,
+            entry="service", synthetic=synthetic)
         return {**body, "tenant": tenant, "router_id": self.router_id}
 
     def _await_grant(self, tenant: str) -> None:
@@ -1796,6 +1847,27 @@ class FleetRouter:
                         f"{last_err or 'no live replicas'}")
 
     # --- reads ---
+
+    def placement_snapshot(self, job_id: str) -> dict | None:
+        """One placement's routing facts as a plain dict (the explain
+        plane's substrate) — copied under the lock, no live references
+        escape."""
+        with self._lock:
+            p = self._placements.get(job_id)
+            if p is None:
+                return None
+            return {
+                "job_id": p.job_id, "tenant": p.tenant,
+                "trace_id": p.trace_id, "state": p.state,
+                "error": p.error, "replica_id": p.replica_id,
+                "base_url": p.base_url,
+                "replica_job_id": p.replica_job_id,
+                "attempts": p.attempts, "submitted_s": p.submitted_s,
+                "shape": list(p.payload.get("shape") or []),
+                "hops": [dict(h) for h in p.hops],
+                "cached": dict(p.cached) if p.cached is not None else None,
+                "synthetic": p.synthetic,
+            }
 
     def job_manifest(self, job_id: str) -> tuple[int, dict]:
         with self._lock:
@@ -1990,6 +2062,64 @@ class FleetRouter:
         ``+Inf``/``NaN`` spellings included — so the reply stays strict
         JSON with no IEEE specials to stringify."""
         return self.history.to_json(ticks=ticks)
+
+    def _recorder_tick(self) -> None:
+        """Republish the recorder's gauge families and delta-feed its
+        counter mirrors from the recorder's own totals (counters only
+        move forward; the recorder's figures are authoritative)."""
+        st = self.recorder.stats()
+        with self._lock:
+            prev = self._recorder_seen
+            self._recorder_seen = {
+                k: st[k] for k in ("entries_total", "excluded_total",
+                                   "dropped_total", "sealed_total")}
+            deltas = {k: st[k] - prev.get(k, 0)
+                      for k in self._recorder_seen}
+        for fam, key in (
+                ("recorder_entries_total", "entries_total"),
+                ("recorder_excluded_total", "excluded_total"),
+                ("recorder_dropped_total", "dropped_total"),
+                ("recorder_segments_sealed_total", "sealed_total")):
+            if deltas.get(key, 0) > 0:
+                self.metrics.count(fam, inc=float(deltas[key]))
+        self.metrics.set_gauge("recorder_enabled", None,
+                               1.0 if st["enabled"] else 0.0)
+        self.metrics.set_gauge("recorder_segments", None,
+                               float(st["segments"]))
+        self.metrics.set_gauge("recorder_segment_bytes", None,
+                               float(st["segment_bytes"]))
+        self.metrics.set_gauge("recorder_open_entries", None,
+                               float(st["open_entries"]))
+
+    def fleet_traces(self, segment: str = "",
+                     t_start: float | None = None,
+                     t_end: float | None = None) -> tuple[int, dict]:
+        """``GET /fleet/traces``: the recorder's sealed-segment inventory
+        (+ live stats), or — with ``?segment=`` / ``?t0=&t1=`` — one
+        windowed export as a replayable trace document (``trace`` is the
+        JSON-line list: write each element as one line and the file
+        loads through ``proving.traces.load_trace`` unchanged)."""
+        if segment or t_start is not None or t_end is not None:
+            try:
+                doc = self.recorder.export(segment=segment,
+                                           t_start=t_start, t_end=t_end)
+            except KeyError:
+                return 404, {"error": f"no sealed segment {segment!r}"}
+            return 200, {"router_id": self.router_id, "trace": doc}
+        return 200, {"router_id": self.router_id,
+                     "directory": self.recorder.out_dir,
+                     "recorder": self.recorder.stats(),
+                     "segments": self.recorder.segments()}
+
+    def fleet_explain_job(self, job_id: str) -> tuple[int, dict]:
+        """``GET /fleet/explain/<job_id>``: the seven-plane causal
+        report for one job (fleet/explain.py) — trace, cost/roofline,
+        zap attribution, audit verdict, quality, cache/coalesce
+        disposition, SLO journeys — each stamped with live/spool/
+        unavailable provenance.  Strict JSON (the /fleet/capacity
+        IEEE-specials discipline: SLO quantiles can be infinite)."""
+        code, report = fleet_explain.explain_job(self, job_id)
+        return code, _json_safe(report)
 
     def fleet_trace(self, trace_id: str) -> tuple[int, dict]:
         """``GET /fleet/trace/<id>``: one stitched cross-hop timeline.
@@ -2343,6 +2473,29 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._reply(200, router.fleet_costs())
         elif self.path == "/fleet/slo":
             self._reply(200, router.fleet_slo())
+        elif self.path.split("?", 1)[0] == "/fleet/traces":
+            query = urllib.parse.parse_qs(
+                urllib.parse.urlsplit(self.path).query)
+
+            def _f(name):
+                if name not in query:
+                    return None
+                return float(query[name][0])
+
+            try:
+                segment = str(query.get("segment", [""])[0])
+                t_start, t_end = _f("t0"), _f("t1")
+            except ValueError:
+                self._reply(400, {"error": "bad ?t0=/?t1= value; want "
+                                           "absolute unix seconds"})
+                return
+            code, payload = router.fleet_traces(
+                segment=segment, t_start=t_start, t_end=t_end)
+            self._reply(code, payload)
+        elif self.path.startswith("/fleet/explain/"):
+            jid = self.path[len("/fleet/explain/"):]
+            code, payload = router.fleet_explain_job(jid)
+            self._reply(code, payload)
         elif self.path.startswith("/fleet/trace/"):
             tid = self.path[len("/fleet/trace/"):]
             code, payload = router.fleet_trace(tid)
@@ -2672,6 +2825,19 @@ def build_fleet_parser() -> argparse.ArgumentParser:
                         "burn-rate alert rules per objective and a "
                         "spool-persisted error-budget ledger "
                         "(journeys: " + ", ".join(fleet_slo.JOURNEYS) + ")")
+    p.add_argument("--no_recorder", action="store_true",
+                   help="disable the production flight recorder (on by "
+                        "default: every real submission is appended to a "
+                        "bounded, rotated trace-segment set under "
+                        "<spool>/fleet-traces, replayable via 'ict-clean "
+                        "prove --replay'; ICT_RECORDER=0 equivalent)")
+    p.add_argument("--recorder_segment_kb", type=int, default=256,
+                   metavar="KB",
+                   help="open-segment size cap before the recorder seals "
+                        "and rotates it (default 256)")
+    p.add_argument("--recorder_keep", type=int, default=16, metavar="N",
+                   help="sealed trace segments retained; the oldest are "
+                        "swept beyond it (default 16)")
     p.add_argument("-q", "--quiet", action="store_true")
     p.add_argument("--smoke", action="store_true",
                    help="offline self-check: 2 in-process replicas behind "
@@ -2766,6 +2932,12 @@ def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
     if args.canary_ticks < 0:
         raise ValueError(f"--canary_ticks must be >= 0 (0 = off), got "
                          f"{args.canary_ticks}")
+    if args.recorder_segment_kb < 1:
+        raise ValueError(f"--recorder_segment_kb must be >= 1, got "
+                         f"{args.recorder_segment_kb}")
+    if args.recorder_keep < 1:
+        raise ValueError(f"--recorder_keep must be >= 1, got "
+                         f"{args.recorder_keep}")
     fleet_slo.parse_slo_specs(args.slo)  # validate NOW, at the CLI surface
     alert_rules: list[dict] = []
     for raw in args.alert_rule:
@@ -2832,6 +3004,9 @@ def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
         alert_retries=args.alert_retries,
         canary_ticks=args.canary_ticks,
         slo=tuple(args.slo),
+        recorder=not args.no_recorder,
+        recorder_segment_kb=args.recorder_segment_kb,
+        recorder_keep=args.recorder_keep,
         quiet=args.quiet,
     )
 
@@ -3323,6 +3498,68 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                 for j in fleet_slo.CANARY_JOURNEYS)
             canary_ok = (canary_green and synthetic_excluded
                          and burn_rules_ok and slo_report_ok)
+            # --- the recorder/explain plane (ISSUE 19), end to end ---
+            # Every REAL submission the smoke has made so far (fresh
+            # placements and fleet-cache resolutions alike) sits on the
+            # flight recorder's open tape, and the synchronous canary
+            # round above injected synthetic traffic that must be absent
+            # BY CONSTRUCTION.  Seal the production window, check the
+            # /fleet/traces inventory, then replay the sealed segment
+            # through the SAME ``prove --replay`` entry point operators
+            # use: every entry must dedupe one-for-one under its
+            # original idempotency key with ZERO new replica work
+            # (service_jobs_done unmoved).  Then the explain plane: all
+            # seven planes for a completed job on a live replica.
+            import contextlib
+            import io as io_mod
+            from iterative_cleaner_tpu.proving import soak as proving_soak
+            from iterative_cleaner_tpu.proving import (
+                traces as proving_traces)
+            rec_stats = router.recorder.stats()
+            seg_path = router.recorder.seal()
+            rec_inventory = json.load(urllib.request.urlopen(
+                f"{base}/fleet/traces", timeout=10))
+            seg_entries = (proving_traces.load_trace(seg_path)
+                           if seg_path else [])
+            rec_clean = (len(seg_entries) >= 1
+                         and rec_stats["excluded_total"] >= 1
+                         and not any(e.tenant == SYNTHETIC_TENANT
+                                     for e in seg_entries))
+            rec_done_before = tracing.counters_snapshot().get(
+                "service_jobs_done", 0)
+            replay_report: dict = {}
+            replay_rc = 1
+            if seg_path:
+                replay_buf = io_mod.StringIO()
+                with contextlib.redirect_stdout(replay_buf):
+                    replay_rc = proving_soak.run_replay(
+                        seg_path, base, compression=1000.0)
+                replay_report = json.loads(
+                    replay_buf.getvalue().strip().splitlines()[-1])
+            rec_jobs_done_unmoved = (tracing.counters_snapshot().get(
+                "service_jobs_done", 0) == rec_done_before)
+            recorder_ok = (
+                rec_clean and replay_rc == 0
+                and replay_report.get("entries") == len(seg_entries)
+                and replay_report.get("dedup_delta") == len(seg_entries)
+                and rec_jobs_done_unmoved
+                and len(rec_inventory.get("segments") or []) >= 1
+                and bool(rec_inventory.get("recorder", {})
+                         .get("enabled")))
+            # Explain: the coalesce jobs finished on live replica b, so
+            # the causal report must carry ALL seven planes with the
+            # replica-backed ones sourced live.
+            exp_job_id = co_jobs[co_paths[0]]["id"]
+            exp_view = json.load(urllib.request.urlopen(
+                f"{base}/fleet/explain/{exp_job_id}", timeout=10))
+            explain_ok = (
+                set(exp_view.get("planes") or {})
+                == set(fleet_explain.PLANES)
+                and exp_view["planes"]["cost"]["source"] == "live"
+                and exp_view["planes"]["trace"]["source"]
+                in ("live", "spool")
+                and exp_view["planes"]["slo"]["source"] == "live"
+                and exp_view.get("state") == "done")
             # --- the cost-accounting plane (ISSUE 15), end to end ---
             # A tenant-tagged job burns through the injected tiny
             # budget; the costs lane then asserts (a) attribution
@@ -3410,11 +3647,24 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                                and "resolved" in burn_cycle)
             costs_ok = (state.get("state") == "done" and conservation_ok
                         and tenant_rows_ok and budget_cycle_ok)
+            # Dead-replica provenance: replica b is gone now, so the
+            # cost job's replica-backed planes must degrade to
+            # "unavailable" (never stale data) while the report itself
+            # still answers with the router-side planes.
+            _dead_code, dead_exp = router.fleet_explain_job(
+                cost_job["id"])
+            explain_dead_ok = (
+                _dead_code == 200
+                and set(dead_exp.get("planes") or {})
+                == set(fleet_explain.PLANES)
+                and dead_exp["planes"]["zaps"]["source"] == "unavailable"
+                and dead_exp["planes"]["cost"]["source"] == "unavailable")
             ok = (all_done and masks_ok and failovers >= 1
                   and done_delta == len(paths)
                   and fleet_ok and trace_ok and len(incidents) >= 1
                   and alerts_ok and coalesce_ok and cache_ok
                   and campaign_ok and canary_ok and costs_ok
+                  and recorder_ok and explain_ok and explain_dead_ok
                   and health_b.get("audits_run", 0) >= 1
                   and health_b.get("audit_divergences", 0) == 0)
             result = {
@@ -3450,6 +3700,15 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                 "canary_synthetic_excluded": bool(synthetic_excluded),
                 "slo_burn_rules_ok": bool(burn_rules_ok),
                 "slo_tick": slo_view.get("tick"),
+                "recorder_lane_ok": bool(recorder_ok),
+                "recorder_segment_entries": len(seg_entries),
+                "recorder_excluded": int(rec_stats["excluded_total"]),
+                "recorder_replay_rc": int(replay_rc),
+                "recorder_replay_dedup_delta": (
+                    replay_report.get("dedup_delta")),
+                "recorder_jobs_done_unmoved": bool(rec_jobs_done_unmoved),
+                "explain_planes_ok": bool(explain_ok),
+                "explain_dead_replica_ok": bool(explain_dead_ok),
                 "costs_lane_ok": bool(costs_ok),
                 "cost_conservation_ratio": (
                     round(cost_sum / dispatch_sum, 4)

@@ -502,6 +502,52 @@ def run_soak(cfg: SoakConfig) -> int:
     return rc
 
 
+def run_replay(trace_path: str, router_url: str,
+               compression: float = 10.0) -> int:
+    """``ict-clean prove --replay``: re-issue one recorded trace (a
+    sealed flight-recorder segment, or a record_trace output) against a
+    LIVE router under the original idempotency keys.  One JSON report
+    line on stdout on every exit path; rc 0 when every entry was
+    submitted and none errored — whether each deduped is visible in the
+    report's dedupe delta (a window the fleet already served must come
+    back all-dedupe, zero new replica work)."""
+    report: dict = {"trace": trace_path, "router": router_url}
+    rc = 1
+    try:
+        entries = traces.load_trace(trace_path)
+        report["entries"] = len(entries)
+
+        def _dedup_total() -> float | None:
+            try:
+                req = urllib.request.urlopen(
+                    f"{router_url.rstrip('/')}/metrics", timeout=10)
+                text = req.read().decode()
+            except (OSError, ValueError):
+                return None
+            for fam in obs_metrics.parse_exposition(text):
+                if fam.name == "ict_fleet_deduped_submissions_total":
+                    return sum(obs_metrics.sample_value(raw)
+                               for _n, _l, raw in fam.samples)
+            return 0.0
+
+        dedup0 = _dedup_total()
+        result = traces.replay_trace(entries, router_url,
+                                     compression=compression)
+        dedup1 = _dedup_total()
+        report.update(result)
+        report["dedup_delta"] = (
+            None if dedup0 is None or dedup1 is None
+            else dedup1 - dedup0)
+        rc = 0 if (not result["errors"]
+                   and result["submitted"] == len(entries)) else 1
+    except (OSError, ValueError) as exc:
+        report["error"] = str(exc)
+    finally:
+        report["rc"] = rc
+        print(json.dumps(report))
+    return rc
+
+
 def prove_main(argv: list | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="ict-clean prove",
@@ -528,8 +574,26 @@ def prove_main(argv: list | None = None) -> int:
                         "path)")
     p.add_argument("--workdir", default="",
                    help="working directory (default: a fresh tempdir)")
+    p.add_argument("--replay", default="", metavar="TRACE",
+                   help="replay ONE recorded trace file (a sealed "
+                        "flight-recorder segment, or a record_trace "
+                        "output) against --router under its original "
+                        "idempotency keys, print a JSON report line, "
+                        "and exit — a window the fleet already served "
+                        "must dedupe one-for-one")
+    p.add_argument("--router", default="http://127.0.0.1:8790",
+                   metavar="URL",
+                   help="fleet router base URL for --replay "
+                        "(default http://127.0.0.1:8790)")
+    p.add_argument("--compression", type=float, default=10.0,
+                   metavar="X",
+                   help="--replay time compression: X times faster than "
+                        "recorded (default 10.0)")
     p.add_argument("-q", "--quiet", action="store_true")
     args = p.parse_args(argv)
+    if args.replay:
+        return run_replay(args.replay, args.router,
+                          compression=args.compression)
     return run_soak(SoakConfig(
         smoke=args.smoke, seed=args.seed, ticks=args.ticks,
         job_budget=args.job_budget, wall_budget_s=args.wall_budget_s,

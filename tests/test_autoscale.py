@@ -486,12 +486,23 @@ def test_idle_scale_down_drain_then_stop_zero_lost(tmp_path):
                  for i in range(2)]
         for p in extra:
             jobs[p] = _post_job(router, {"path": p, "shape": [4, 16, 64]})
-        assert _tick_until(router, lambda: all(
-            _get(router, f"/jobs/{j['id']}").get("state") in TERMINAL
-            for j in jobs.values()), timeout_s=120.0)
-        states = {p: _get(router, f"/jobs/{j['id']}")
-                  for p, j in jobs.items()}
-        assert all(s["state"] == "done" for s in states.values())
+        # Bounded wait folding the FULL postcondition into the
+        # predicate (the scale_up-bundle idiom above): a job is
+        # HTTP-visible terminal a beat before the worker publishes
+        # out_path, so a state-only wait followed by a re-sample can
+        # catch the gap (KeyError 'out_path').  Assert off the states
+        # the predicate itself captured.
+        states: dict = {}
+
+        def _all_done_with_outputs():
+            states.clear()
+            states.update({p: _get(router, f"/jobs/{j['id']}")
+                           for p, j in jobs.items()})
+            return all(s.get("state") == "done" and s.get("out_path")
+                       for s in states.values())
+
+        assert _tick_until(router, _all_done_with_outputs,
+                           timeout_s=120.0)
         for p, s in states.items():
             got = NpzIO().load(s["out_path"]).weights
             assert np.array_equal(got, _oracle_weights(p))

@@ -316,6 +316,9 @@ class TestPerfGateIngestContract:
         # The proving-ground fleet block (ISSUE 17): a bare {} would
         # (correctly) fail the "no scaling_ratio" check.
         payload["fleet"] = {"scaling_ratio": 1.0}
+        # The flight-recorder block (ISSUE 19): a bare {} would
+        # (correctly) fail the "no overhead_frac" check.
+        payload["recorder"] = {"overhead_frac": 0.01}
         payload["donation_ledger"] = dict(base["donation_ledger"])
         assert pg.compare(payload, base, 3.0, 1.15) == []
 

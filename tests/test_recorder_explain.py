@@ -1,0 +1,292 @@
+"""ISSUE 19: the production flight recorder + per-job explain plane.
+
+Offline half (no fleet): the FlightRecorder's rotation/sealing under
+size caps (every sealed segment a loadable PR-17 grammar file, entries
+conserved across the roll), the bounded keep sweep, crash adoption of
+the ``.part`` open journal, synthetic/canary exclusion by construction,
+disabled-mode drop accounting, the named/windowed export grammar, and
+the explain plane-name pin.
+
+Live half: a hermetic ProvingFleet — real traffic recorded WHILE a
+canary round runs (zero synthetic entries in the sealed segment), the
+sealed window replaying one-for-one (the dedupe counter moves
+entry-for-entry, the replica completion counter not at all), the
+``GET /fleet/traces`` inventory + export routes, and the explain
+report's seven planes with live -> unavailable provenance across a
+replica death.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from iterative_cleaner_tpu.fleet import explain as fleet_explain
+from iterative_cleaner_tpu.proving import scenarios, traces
+from iterative_cleaner_tpu.proving.recorder import (
+    OPEN_PART,
+    FlightRecorder,
+)
+from iterative_cleaner_tpu.proving.soak import ProvingFleet
+
+
+# --------------------------------------------------------------------------
+# Recorder (offline)
+# --------------------------------------------------------------------------
+
+
+def _record_n(rec: FlightRecorder, n: int, t0: float = 1000.0,
+              prefix: str = "job") -> None:
+    for i in range(n):
+        assert rec.record(path=f"/data/{prefix}{i}.npz", tenant="prod",
+                          idem_key=f"{prefix}:{i}", shape=(4, 16, 64),
+                          bucket="4x16x64", trace_id=f"tr-{prefix}-{i}",
+                          ts=t0 + i)
+
+
+def test_rotation_seals_under_size_cap(tmp_path):
+    """A 1 KiB segment cap over ~170-byte entries must roll repeatedly:
+    several sealed segments, each independently loadable by the PR-17
+    grammar, with the entry count conserved across the rotation."""
+    rec = FlightRecorder(str(tmp_path / "tape"), max_segment_kb=1,
+                         keep=64)
+    _record_n(rec, 30)
+    rows = rec.segments()
+    assert len(rows) >= 2
+    sealed_entries = 0
+    for row in rows:
+        entries = traces.load_trace(row["path"])
+        assert len(entries) == row["entries"] >= 1
+        assert all(e.tenant == "prod" and e.idem_key for e in entries)
+        sealed_entries += len(entries)
+    stats = rec.stats()
+    assert sealed_entries + stats["open_entries"] == 30
+    assert stats["entries_total"] == 30
+    assert stats["sealed_total"] == len(rows)
+    assert stats["dropped_total"] == 0
+    # The inventory rows expose real on-disk bytes and the header t0.
+    assert all(r["bytes"] > 0 and r["t0"] >= 1000.0 for r in rows)
+
+
+def test_keep_sweeps_oldest_segments(tmp_path):
+    """Beyond ``keep`` sealed segments the oldest are swept — the
+    recorder is bounded by construction, and the survivors are the
+    NEWEST window (sequence numbers are age)."""
+    rec = FlightRecorder(str(tmp_path / "tape"), max_segment_kb=1,
+                         keep=2)
+    _record_n(rec, 40)
+    rec.seal()
+    names = [r["name"] for r in rec.segments()]
+    assert len(names) == 2
+    all_seqs = sorted(int(n[4:10]) for n in names)
+    # the surviving pair is the highest-numbered (latest) window
+    assert all_seqs[-1] == rec.stats()["sealed_total"] - 1
+
+
+def test_synthetic_and_canary_excluded_by_construction(tmp_path):
+    """Probe traffic never reaches the tape: the synthetic flag and the
+    ``_canary`` tenant are both refused BEFORE any byte is written, and
+    an all-synthetic window leaves nothing to seal."""
+    rec = FlightRecorder(str(tmp_path / "tape"))
+    assert rec.record(path="/p.npz", synthetic=True) is False
+    assert rec.record(path="/p.npz", tenant="_canary") is False
+    stats = rec.stats()
+    assert stats["excluded_total"] == 2
+    assert stats["entries_total"] == 0 and stats["open_entries"] == 0
+    assert rec.seal() is None
+    assert not os.path.exists(os.path.join(rec.out_dir, OPEN_PART))
+
+
+def test_disabled_recorder_counts_drops(tmp_path):
+    """ICT_RECORDER=0 / --no_recorder semantics: real traffic is
+    DROPPED (and counted — the gap is visible), synthetic is still
+    counted excluded, and no tape directory is created."""
+    d = str(tmp_path / "tape_off")
+    rec = FlightRecorder(d, enabled=False)
+    assert rec.record(path="/real.npz", tenant="prod") is False
+    assert rec.record(path="/probe.npz", synthetic=True) is False
+    stats = rec.stats()
+    assert stats["enabled"] is False
+    assert stats["dropped_total"] == 1
+    assert stats["excluded_total"] == 1
+    assert not os.path.isdir(d)
+
+
+def test_part_journal_adoption_survives_restart(tmp_path):
+    """Crash durability: a successor recorder re-adopts the open
+    ``.part`` journal (skipping the torn last line), continues the
+    sealed sequence past the highest existing segment, and seals the
+    inherited window into a loadable grammar file."""
+    d = str(tmp_path / "tape")
+    r1 = FlightRecorder(d)
+    _record_n(r1, 1, t0=1000.0, prefix="sealed")
+    first = r1.seal()
+    assert first and first.endswith("seg-000000.trace.jsonl")
+    _record_n(r1, 2, t0=2000.0, prefix="open")
+    with open(os.path.join(d, OPEN_PART), "a") as fh:
+        fh.write('{"torn half-line')   # the crash
+    r2 = FlightRecorder(d)
+    assert r2.stats()["open_entries"] == 2
+    second = r2.seal()
+    assert second and second.endswith("seg-000001.trace.jsonl")
+    entries = traces.load_trace(second)
+    assert [e.idem_key for e in entries] == ["open:0", "open:1"]
+
+
+def test_export_named_and_windowed(tmp_path):
+    """The export surface behind ``GET /fleet/traces``: a named segment
+    comes back verbatim; a time window merges sealed entries by
+    ABSOLUTE arrival time under a fresh header; either document written
+    one-json-dumps-per-element IS a loadable trace file.  Unknown and
+    path-traversal names raise KeyError (the 404)."""
+    rec = FlightRecorder(str(tmp_path / "tape"))
+    _record_n(rec, 2, t0=1000.0, prefix="old")
+    rec.seal()
+    _record_n(rec, 2, t0=2000.0, prefix="new")
+    rec.seal()
+    name = rec.segments()[0]["name"]
+    doc = rec.export(segment=name)
+    assert doc[0]["kind"] == traces.TRACE_KIND
+    assert doc[0]["entries"] == 2 == len(doc) - 1
+    windowed = rec.export(t_start=1500.0)
+    assert windowed[0]["entries"] == 2
+    assert [r["path"] for r in windowed[1:]] == ["/data/new0.npz",
+                                                 "/data/new1.npz"]
+    out = tmp_path / "window.trace.jsonl"
+    out.write_text("".join(json.dumps(rec_) + "\n" for rec_ in windowed))
+    assert len(traces.load_trace(str(out))) == 2
+    with pytest.raises(KeyError):
+        rec.export(segment="seg-999999.trace.jsonl")
+    with pytest.raises(KeyError):
+        rec.export(segment=f"..{os.sep}evil.trace.jsonl")
+
+
+def test_explain_planes_pinned():
+    """The seven-plane contract the report (and its renderer, and the
+    smoke's assertions) are built on."""
+    assert fleet_explain.PLANES == ("trace", "cost", "zaps", "audit",
+                                    "quality", "cache", "slo")
+
+
+# --------------------------------------------------------------------------
+# Recorder + explain (live fleet)
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    f = ProvingFleet(str(tmp_path), seed=90210)
+    yield f
+    f.close()
+
+
+def test_recorded_window_replays_one_for_one_while_canaries_run(fleet):
+    """The acceptance loop: serve real traffic, run a full canary round
+    concurrently (the driver thread keeps ticking so probes progress),
+    seal — the segment carries every real submission and ZERO synthetic
+    entries — then replay the sealed window: every entry dedupes under
+    its original idempotency key and the replica completion counter
+    does not move."""
+    subs = scenarios.gen_small_flood(fleet.workdir, 90211, 3)
+    replies = [fleet.submit(s) for s in subs]
+    fleet.await_terminal([r["id"] for r in replies])
+
+    verdicts: list = []
+    th = threading.Thread(
+        target=lambda: verdicts.extend(fleet.router.canary.run_round()),
+        daemon=True)
+    th.start()
+    deadline = time.time() + 180
+    while th.is_alive() and time.time() < deadline:
+        fleet.tick()
+        time.sleep(0.05)
+    th.join(5)
+    assert not th.is_alive(), "canary round did not finish"
+    assert verdicts, "canary round produced no traffic"
+    assert fleet.router.recorder.stats()["excluded_total"] >= 1
+
+    seg = fleet.router.recorder.seal()
+    assert seg
+    entries = traces.load_trace(seg)
+    assert len(entries) >= 3
+    assert all(e.tenant != "_canary" for e in entries)
+    real_paths = {s.path for s in subs}
+    assert real_paths <= {e.path for e in entries}
+
+    # The HTTP inventory + export surface over the same tape.
+    inv = json.load(urllib.request.urlopen(
+        f"{fleet.base_url}/fleet/traces", timeout=10))
+    assert inv["recorder"]["enabled"] is True
+    assert [r["name"] for r in inv["segments"]] == [os.path.basename(seg)]
+    doc = json.load(urllib.request.urlopen(
+        f"{fleet.base_url}/fleet/traces?segment={os.path.basename(seg)}",
+        timeout=10))
+    assert doc["trace"][0]["entries"] == len(entries)
+
+    done0 = fleet.jobs_done()
+    dedup0 = fleet.router.metrics.counter_total(
+        "fleet_deduped_submissions_total")
+    report = traces.replay_trace(entries, fleet.base_url,
+                                 compression=1000.0)
+    assert report["errors"] == []
+    assert report["submitted"] == len(entries)
+    dedup_delta = fleet.router.metrics.counter_total(
+        "fleet_deduped_submissions_total") - dedup0
+    assert dedup_delta == len(entries)
+    assert fleet.jobs_done() == done0
+
+
+def test_explain_seven_planes_live_then_unavailable(tmp_path):
+    """One completed job's causal report: all seven planes, the
+    replica-backed ones live while its replica is up — and honestly
+    ``unavailable`` (never stale) once every replica is dead, with the
+    router-side planes (trace spans, SLO) still answering."""
+    fleet = ProvingFleet(str(tmp_path), seed=90310, replicas=1)
+    try:
+        sub = scenarios.gen_small_flood(fleet.workdir, 90311, 1)[0]
+        reply = fleet.submit(sub)
+        jid = reply["id"]
+        fleet.await_terminal([jid])
+        code, rep = fleet.router.fleet_explain_job(jid)
+        assert code == 200
+        assert set(rep["planes"]) == set(fleet_explain.PLANES)
+        assert rep["state"] == "done" and rep["synthetic"] is False
+        assert rep["planes"]["cost"]["source"] == "live"
+        assert rep["planes"]["zaps"]["source"] == "live"
+        assert rep["planes"]["slo"]["source"] == "live"
+        assert rep["planes"]["cache"]["fleet_cache_hit"] is False
+        assert "admission" in rep["planes"]["slo"]["journeys"]
+
+        # The CLI half over the same endpoint: fetch + human rendering.
+        h_code, h_rep = fleet_explain.fetch_explain(fleet.base_url, jid)
+        assert h_code == 200
+        text = fleet_explain.render_explain(h_rep)
+        for plane in fleet_explain.PLANES:
+            assert plane in text
+        assert fleet_explain.fetch_explain(
+            fleet.base_url, "no-such-job")[0] == 404
+
+        # Kill the only replica; once the registry marks it dead the
+        # replica-backed planes must degrade to unavailable.
+        fleet.services[0].stop()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            fleet.tick()
+            if fleet.router.health().get("replicas_alive") == 0:
+                break
+            time.sleep(0.05)
+        assert fleet.router.health().get("replicas_alive") == 0
+        code2, dead = fleet.router.fleet_explain_job(jid)
+        assert code2 == 200
+        assert set(dead["planes"]) == set(fleet_explain.PLANES)
+        assert dead["planes"]["zaps"]["source"] == "unavailable"
+        assert dead["planes"]["cost"]["source"] == "unavailable"
+        assert dead["planes"]["slo"]["source"] == "live"
+        assert dead["state"] == "done"
+    finally:
+        fleet.close()

@@ -16,8 +16,13 @@ section off the proving ground's ``ict_prove_*`` gauges when an
 SLO section off the SLI/error-budget plane (``GET /fleet/slo``:
 per-journey availability/correctness, p99 latency, budget remaining,
 burn rates, and the canary prober's round count — docs/OBSERVABILITY.md
-"Canary probing & SLOs"), and a
-FIRING ALERTS section off the alerting plane.  ``--json`` prints the same snapshot as ONE JSON line
+"Canary probing & SLOs"), a RECORDER line off the production flight
+recorder's segment inventory (``GET /fleet/traces``: sealed segments,
+bytes, open tape, entry/excluded/dropped tallies), and a
+FIRING ALERTS section off the alerting plane.  ``fleet_top.py explain
+<job_id>`` is a one-shot mode instead: it prints the per-job causal
+report off ``GET /fleet/explain/<job_id>`` (the same renderer as
+``ict-clean explain``) and exits.  ``--json`` prints the same snapshot as ONE JSON line
 for scripting (the bench.py one-line contract); ``--watch N``
 re-renders every N seconds until interrupted (one JSON line per
 refresh in ``--json`` mode).  Read-only: five GETs, no mutation, safe
@@ -73,6 +78,10 @@ def collect(base: str, timeout_s: float = 10.0) -> dict:
         slo = _get_json(base, "/fleet/slo", timeout_s)
     except (urllib.error.URLError, OSError, ValueError):
         slo = {}      # pre-SLO routers still render everything else
+    try:
+        traces = _get_json(base, "/fleet/traces", timeout_s)
+    except (urllib.error.URLError, OSError, ValueError):
+        traces = {}   # pre-recorder routers still render everything else
     p50s: dict[str, float] = {}
     scale_events = 0.0
     # bucket -> {k -> dispatch count} (the merged fleet-wide coalesce
@@ -144,6 +153,7 @@ def collect(base: str, timeout_s: float = 10.0) -> dict:
         "fleet_cache": health.get("result_cache") or {},
         "campaigns": health.get("campaigns") or {},
         "slo": slo,
+        "recorder": traces.get("recorder") or {},
         "soak": ({"scenarios": soak_scenarios, "faults": soak_faults,
                   "verdict": soak_verdict,
                   "sink_degraded": soak_sink_degraded}
@@ -260,6 +270,7 @@ def render(snap: dict) -> str:
                   f"cache={_fmt_num(fc.get('hits'))}h/"
                   f"{_fmt_num(fc.get('misses'))}m"
                   f" ({_fmt_num(fc.get('entries'))} idx)"]
+    lines += render_recorder(snap.get("recorder") or {})
     scaler = capacity.get("autoscale")
     if scaler:
         last = scaler.get("last_decision") or {}
@@ -403,6 +414,25 @@ def render_slo(slo: dict) -> list[str]:
     return lines
 
 
+def render_recorder(rec: dict) -> list[str]:
+    """The RECORDER line (from ``GET /fleet/traces``): the production
+    flight recorder's footprint — sealed segments on disk and their
+    bytes, the open tape depth, and the lifetime entry/excluded/dropped
+    tallies (dropped > 0 means real traffic is NOT fully replayable —
+    docs/OBSERVABILITY.md "Production recorder & explain plane").
+    Empty (line absent) when the router predates the recorder."""
+    if not rec:
+        return []
+    return [
+        f"recorder {'on' if rec.get('enabled') else 'OFF'}  "
+        f"segments={_fmt_num(rec.get('segments'))} "
+        f"({_fmt_num(rec.get('segment_bytes'))}B)  "
+        f"open={_fmt_num(rec.get('open_entries'))}  "
+        f"entries={_fmt_num(rec.get('entries_total'))}  "
+        f"excluded={_fmt_num(rec.get('excluded_total'))}  "
+        f"dropped={_fmt_num(rec.get('dropped_total'))}"]
+
+
 def render_alerts(alerts: dict) -> list[str]:
     """The FIRING ALERTS section (from ``GET /fleet/alerts``): one row
     per firing (rule, series) — severity, rule, series labels, the
@@ -447,8 +477,33 @@ def main(argv: list[str] | None = None) -> int:
                    help="with --watch: stop after K refreshes "
                         "(0 = until interrupted; the offline-test hook)")
     p.add_argument("--timeout_s", type=float, default=10.0, metavar="S")
+    p.add_argument("command", nargs="*", metavar="CMD",
+                   help="optional one-shot command: 'explain <job_id>' "
+                        "prints the per-job causal report off "
+                        "GET /fleet/explain/<job_id> and exits")
     args = p.parse_args(argv)
     base = args.router.rstrip("/")
+
+    if args.command:
+        # The explain one-shot: same endpoint, same renderer as
+        # ``ict-clean explain`` — fleet_top just saves the operator a
+        # tool switch mid-investigation.
+        from iterative_cleaner_tpu.fleet import explain as fleet_explain
+        if args.command[0] != "explain" or len(args.command) != 2:
+            print(f"error: unknown command {' '.join(args.command)!r}; "
+                  "want: explain <job_id>", file=sys.stderr)
+            return 2
+        code, report = fleet_explain.fetch_explain(
+            base, args.command[1], timeout_s=args.timeout_s)
+        if args.json:
+            print(json.dumps(report, default=str))
+            return 0 if code == 200 else 1
+        if code != 200:
+            print(f"error: explain {args.command[1]}: HTTP {code} "
+                  f"{report.get('error', '')}", file=sys.stderr)
+            return 1
+        print(fleet_explain.render_explain(report))
+        return 0
 
     def one_shot() -> int:
         try:
