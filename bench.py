@@ -32,8 +32,8 @@ failure degrades the payload instead of zeroing it.
 
 Env knobs: BENCH_NSUB/NCHAN/NBIN (config A), BENCH_B_NSUB/NCHAN/NBIN,
 BENCH_MAX_ITER, BENCH_WATCHDOG_S, BENCH_SKIP_NORTHSTAR/PALLAS/CHUNKED/
-PHASES/INGEST/FLEET/RECORDER, BENCH_FULL_NUMPY=0 (downgrade config A
-numpy to one step).
+PHASES/INGEST/FLEET/RECORDER/TRENDS, BENCH_FULL_NUMPY=0 (downgrade
+config A numpy to one step).
 """
 
 from __future__ import annotations
@@ -226,6 +226,8 @@ def _headline(payload: dict) -> dict:
     # Same contract for the flight-recorder overhead arm (ISSUE 19):
     # per-router state, nothing to salvage — the key still travels.
     payload.setdefault("recorder", {"status": "did_not_run"})
+    # And for the trend-plane overhead arm (ISSUE 20).
+    payload.setdefault("trends", {"status": "did_not_run"})
     try:
         from iterative_cleaner_tpu.analysis.contracts import ROUTE_DONATIONS
 
@@ -1041,6 +1043,89 @@ def _bench_recorder() -> dict:
     return res
 
 
+def _bench_trends() -> dict:
+    """Trend-plane overhead (ISSUE 20): warm jobs/s through a 2-replica
+    in-process fleet with the durable performance-trend plane ON (the
+    default) versus OFF (``ICT_TRENDS=0``) — the rollup fold + the
+    fingerprint sentinel run once per poll tick off the already-parsed
+    exposition, so their cost must stay in the noise (the perf gate
+    collapse-ratchets the overhead fraction).  Same harness discipline
+    as the recorder arm: one untimed priming fleet, each arm its own
+    fleet with distinct seeded cubes, best-of-3 timed repetitions;
+    BENCH_TRENDS_K overrides the per-rep job count (default 8).  The
+    on-arm also asserts the plane actually ran (ticks advanced, series
+    tracked) and that a CLEAN bench fired zero regressions."""
+    import shutil
+    import tempfile
+
+    from iterative_cleaner_tpu.proving import scenarios as prove_scen
+    from iterative_cleaner_tpu.proving.soak import ProvingFleet
+
+    k = int(os.environ.get("BENCH_TRENDS_K", 8))
+    nsub, nchan, nbin = prove_scen.SMALL_SHAPE
+    wall: dict[str, float] = {}
+    trend_stats: dict = {}
+    arms = (("prime", "0", 533_100), ("on", "1", 534_200),
+            ("off", "0", 535_200))
+    for arm, env_val, seed in arms:
+        tmp = tempfile.mkdtemp(prefix=f"ict_bench_trend_{arm}_")
+        prev = os.environ.get("ICT_TRENDS")
+        os.environ["ICT_TRENDS"] = env_val
+        try:
+            fleet = ProvingFleet(tmp, seed=seed, backend="jax", replicas=2)
+            try:
+                warm = prove_scen.gen_small_flood(tmp, seed + 1, 2)
+                fleet.await_terminal([fleet.submit(s)["id"] for s in warm])
+                if arm == "prime":
+                    continue  # one-time process warmup only; never timed
+                for rep in range(3):
+                    mix = prove_scen.gen_small_flood(
+                        tmp, seed + 100 + rep * 1000, k)
+                    t0 = time.perf_counter()
+                    fleet.await_terminal(
+                        [fleet.submit(s)["id"] for s in mix])
+                    dt = time.perf_counter() - t0
+                    wall[arm] = min(wall.get(arm, float("inf")), dt)
+                if arm == "on" and fleet.router.trends is not None:
+                    plane = fleet.router.trends
+                    trend_stats = {
+                        "ticks": plane.store.ticks(),
+                        "series": plane.store.series_count(),
+                        "regressions_total": plane.regressions_total(),
+                    }
+            finally:
+                fleet.close()
+        finally:
+            if prev is None:
+                os.environ.pop("ICT_TRENDS", None)
+            else:
+                os.environ["ICT_TRENDS"] = prev
+            shutil.rmtree(tmp, ignore_errors=True)
+    jps_on = k / max(wall["on"], 1e-9)
+    jps_off = k / max(wall["off"], 1e-9)
+    overhead = max(0.0, 1.0 - jps_on / max(jps_off, 1e-9))
+    res = {
+        "jobs": k,
+        "shape": [nsub, nchan, nbin],
+        "warm_on_s": round(wall["on"], 4),
+        "warm_off_s": round(wall["off"], 4),
+        "jobs_per_s_on": round(jps_on, 2),
+        "jobs_per_s_off": round(jps_off, 2),
+        "overhead_frac": round(overhead, 4),
+        "trended_on": bool(trend_stats.get("ticks", 0) >= 1
+                           and trend_stats.get("series", 0) >= 1),
+        "trend_ticks": int(trend_stats.get("ticks", 0)),
+        "trend_series": int(trend_stats.get("series", 0)),
+        "regressions_total": int(trend_stats.get("regressions_total", 0)),
+    }
+    log(f"[trends] {k} jobs on={wall['on']:.3f}s ({res['jobs_per_s_on']}"
+        f"/s) off={wall['off']:.3f}s ({res['jobs_per_s_off']}/s) -> "
+        f"overhead {overhead * 100:.1f}% (ticks={res['trend_ticks']} "
+        f"series={res['trend_series']} "
+        f"regressions={res['regressions_total']})")
+    return res
+
+
 def _bench_costs() -> dict:
     """Cost & efficiency accounting (ISSUE 15): the roofline attainment
     of the measured config — achieved bytes/s (the fused executable's
@@ -1652,6 +1737,15 @@ def run_bench() -> dict:
         rec = _PAYLOAD.get("recorder", {})
         if isinstance(rec, dict) and "overhead_frac" in rec:
             _PAYLOAD["recorder_overhead_frac"] = rec["overhead_frac"]
+
+    if os.environ.get("BENCH_SKIP_TRENDS", "0") == "0":
+        # The trend-plane arm (ISSUE 20) rides the same hermetic-fleet
+        # harness: sentinel + rollup store overhead on the poll path
+        # must stay in the noise; the gate collapse-ratchets it.
+        run_section("trends", _bench_trends)
+        tr = _PAYLOAD.get("trends", {})
+        if isinstance(tr, dict) and "overhead_frac" in tr:
+            _PAYLOAD["trends_overhead_frac"] = tr["overhead_frac"]
 
     # --- config B: the north-star shape class ---
     # Runs BEFORE the chunked arm: the r03 interim run lost config B to a

@@ -246,6 +246,15 @@ def main(argv: list[str] | None = None) -> int:
         from iterative_cleaner_tpu.fleet.explain import explain_main
 
         return explain_main(argv[1:])
+    if argv and argv[0] == "trends" and not os.path.isfile("trends"):
+        # One-shot performance-trend report: fetch GET /fleet/trends from
+        # a fleet router and render fingerprints, sparklined rings, and
+        # firing regressions (docs/OBSERVABILITY.md "Performance trends &
+        # regression sentinel"); same literal-token dispatch rule as
+        # ``serve``.
+        from iterative_cleaner_tpu.fleet.trends import trends_main
+
+        return trends_main(argv[1:])
     if argv and argv[0] == "serve-fleet" and not os.path.isfile("serve-fleet"):
         # The fleet router in front of N daemon replicas (docs/SERVING.md
         # "Fleet"); same literal-token dispatch rule as ``serve``, and

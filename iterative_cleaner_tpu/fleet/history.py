@@ -184,16 +184,28 @@ class MetricsHistory:
 
     # --- the HTTP view ---
 
-    def to_json(self, ticks: int | None = None) -> dict:
+    def to_json(self, ticks: int | None = None,
+                families: tuple = ()) -> dict:
         """The ``GET /fleet/metrics/history`` body: newest ``ticks``
         records oldest-first, each tick's families in the lossless
-        strict-JSON shape (:func:`family_to_json`)."""
+        strict-JSON shape (:func:`family_to_json`).  ``families`` is an
+        optional tuple of family-name PREFIXES (the ``?families=``
+        filter): each tick keeps only matching families, in original
+        order, so a filtered tick still re-renders byte-exact for the
+        families it carries — same grammar, smaller wire cost."""
         recs = self.window(ticks)
+        prefixes = tuple(p for p in families if p)
+
+        def keep_fam(fam: MetricFamily) -> bool:
+            return (not prefixes
+                    or any(fam.name.startswith(p) for p in prefixes))
+
         return {
             "keep": self.keep,
             "ticks": [{
                 "tick": rec["tick"],
                 "ts": rec["ts"],
-                "families": [family_to_json(f) for f in rec["families"]],
+                "families": [family_to_json(f) for f in rec["families"]
+                             if keep_fam(f)],
             } for rec in recs],
         }

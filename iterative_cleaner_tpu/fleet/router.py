@@ -61,6 +61,7 @@ from iterative_cleaner_tpu.fleet import costs as fleet_costs
 from iterative_cleaner_tpu.fleet import history as fleet_history
 from iterative_cleaner_tpu.fleet import obs as fleet_obs
 from iterative_cleaner_tpu.fleet import slo as fleet_slo
+from iterative_cleaner_tpu.fleet import trends as fleet_trends
 from iterative_cleaner_tpu.fleet.client import (
     ReplicaClient,
     ReplicaRefused,
@@ -201,6 +202,25 @@ class FleetConfig:
     recorder_segment_kb: int = 256   # open-segment size cap before a
                                      # seal rotates it
     recorder_keep: int = 16          # sealed segments retained
+    trends: bool = True              # the durable performance-trend plane
+                                     # (fleet/trends.py): multi-resolution
+                                     # spool-persisted rollups + the
+                                     # regression sentinel; off via
+                                     # --no_trends / ICT_TRENDS=0
+    trend_keep_raw: int = 128        # raw per-tick points kept per series
+    trend_signals: tuple = ()        # extra/override fingerprint signal
+                                     # specs (dicts, the --trend_signal
+                                     # JSON shape) on top of the default
+                                     # set; same-name specs replace
+    trend_sentinel_k: int = 3        # consecutive out-of-band windows
+                                     # before the sentinel fires
+    trend_min_samples: int = 8       # accepted windows before a
+                                     # fingerprint arms
+    trend_band_mad: float = 4.0      # fingerprint band half-width in
+                                     # MAD units
+    trend_persist_every: int = 16    # poll ticks between trend-store
+                                     # spool writes (stop() always
+                                     # persists)
     quiet: bool = False
 
 
@@ -447,6 +467,17 @@ class FleetRouter:
         # before the operator loop, so --alert_rule names still replace.
         self._slo_objectives = fleet_slo.parse_slo_specs(cfg.slo)
         rules.extend(fleet_slo.burn_rules(self._slo_objectives))
+        # The regression sentinel's bridge rule (fleet/trends.py; ISSUE
+        # 20): one source="trend" rule over the
+        # ict_fleet_perf_regression gauge the trend plane republishes
+        # each tick — it fires per series, so one rule covers every
+        # fingerprint key.  Installed the budget_rules way, before the
+        # operator loop, so --alert_rule names still replace.
+        self._trends_enabled = (cfg.trends
+                                and os.environ.get("ICT_TRENDS",
+                                                   "1") != "0")
+        if self._trends_enabled:
+            rules.extend(fleet_trends.trend_rules())
         for spec in cfg.alert_rules:
             rule = (spec if isinstance(spec, fleet_alerts.AlertRule)
                     else fleet_alerts.parse_rule(spec))
@@ -592,6 +623,53 @@ class FleetRouter:
                     "recorder_segments_sealed_total"):
             self.metrics.count(fam, inc=0.0)
         self._recorder_tick()
+        # The durable performance-trend plane (fleet/trends.py; ISSUE
+        # 20): multi-resolution spool-persisted rollups over the SAME
+        # parsed exposition the history ring records (zero new scrape
+        # traffic), performance fingerprints, and the regression
+        # sentinel.  Rehydrated NOW from <spool>/trends so the rings
+        # survive a restart; its locks sit strictly after the router's.
+        self.trends = None
+        if self._trends_enabled:
+            specs = {s.name: s for s in fleet_trends.default_signals()}
+            for spec in cfg.trend_signals:
+                s = (spec if isinstance(spec, fleet_trends.SignalSpec)
+                     else fleet_trends.parse_signal(spec))
+                specs[s.name] = s
+            baseline = os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__)))),
+                "docs", "bench_baseline_cpu.json")
+            self.trends = fleet_trends.TrendPlane(fleet_trends.TrendConfig(
+                spool_dir=cfg.spool_dir,
+                keep_raw=cfg.trend_keep_raw,
+                signals=tuple(specs.values()),
+                sentinel_k=cfg.trend_sentinel_k,
+                min_samples=cfg.trend_min_samples,
+                band_mad=cfg.trend_band_mad,
+                persist_every=cfg.trend_persist_every,
+                baseline_path=baseline if os.path.isfile(baseline) else "",
+                quiet=cfg.quiet))
+        # Pre-register the whole ict_fleet_trend_* surface at zero (the
+        # budget-gauge lesson) so every documented family is live on the
+        # first scrape regardless of whether a rollup has sealed or a
+        # persist has run; the regression counter rides along so the
+        # sentinel's firing increment is a delta on an existing series.
+        self.metrics.count("fleet_trend_ticks_total", inc=0.0)
+        for res in fleet_trends.RESOLUTIONS:
+            self.metrics.count("fleet_trend_rollups_total",
+                               {"resolution": f"{res}s"}, inc=0.0)
+        self.metrics.count("fleet_trend_persist_total", inc=0.0)
+        self.metrics.count("fleet_trend_persist_errors_total", inc=0.0)
+        self.metrics.count("fleet_perf_regressions_total", inc=0.0)
+        self.metrics.set_gauge("fleet_trend_enabled", None,
+                               1.0 if self.trends is not None else 0.0)
+        self.metrics.set_gauge("fleet_trend_series", None,
+                               float(self.trends.store.series_count())
+                               if self.trends is not None else 0.0)
+        # Persist-counter delta mirror (the recorder discipline: the
+        # plane's totals are authoritative, counters only move forward).
+        self._trend_persist_seen: dict = {}  # ict: guarded-by(self._lock)
         # Streaming-session proxy routes: fleet session id -> (replica
         # base_url, trace_id), bounded FIFO so an abandoned session can
         # never grow the map without bound.
@@ -681,6 +759,11 @@ class FleetRouter:
             self._cond.notify_all()
         for th in self._threads:
             th.join(timeout=10)
+        # Final trend-store persist AFTER the poll thread is down (no
+        # tick can race the snapshot): a restarted router rehydrates
+        # rings byte-identical to what this life last saw.
+        if self.trends is not None:
+            self.trends.persist(force=True)
 
     # --- the poll loop: health, status refresh, failover, gauges ---
 
@@ -1336,7 +1419,13 @@ class FleetRouter:
         at the cost of re-tokenizing one exposition per tick — a few ms
         at fleet scale, on the poll thread's 1 s cadence."""
         families = obs_metrics.parse_exposition(self.fleet_metrics())
-        self.history.append(families)
+        rec = self.history.append(families)
+        # The trend plane folds the SAME parsed tick in (zero extra
+        # parse work) and republishes the regression gauge; like
+        # fleet_alerts_firing below, that gauge lands in the NEXT
+        # tick's history record, which is exactly when the
+        # perf_regression rule evaluates it.
+        self._trend_tick(families, rec["ts"])
         verdict = self.alerts.evaluate(self.history)
         for alert in verdict["fired"]:
             self.metrics.count("fleet_alerts_total",
@@ -1384,6 +1473,74 @@ class FleetRouter:
             "fleet_alerts_firing",
             {(("rule", name),): float(n)
              for name, n in self.alerts.firing_counts().items()})
+
+    def _trend_tick(self, families: list, ts: float) -> None:
+        """One tick of the trend plane (fleet/trends.py): fold the
+        already-parsed exposition into the multi-resolution store,
+        evaluate due fingerprint windows, republish the
+        ``ict_fleet_perf_regression`` gauge (every ever-fired key stays
+        present at 0 — the alert engine freezes on missing series), and
+        fan each sentinel transition out: counter, event log, flight
+        ring, and — for firings — a trend incident bundle carrying the
+        offending window, the violated fingerprint, and the bench
+        baseline cross-check where the signal is machine-independent."""
+        if self.trends is None:
+            return
+        out = self.trends.tick(families, ts)
+        self.metrics.count("fleet_trend_ticks_total")
+        for res_label, sealed in out["rollups"].items():
+            if sealed:
+                self.metrics.count("fleet_trend_rollups_total",
+                                   {"resolution": res_label},
+                                   inc=float(sealed))
+        self.metrics.set_gauge("fleet_trend_series", None,
+                               float(self.trends.store.series_count()))
+        pstats = self.trends.persist_stats()
+        with self._lock:
+            prev = self._trend_persist_seen
+            self._trend_persist_seen = dict(pstats)
+            deltas = {k: pstats[k] - prev.get(k, 0) for k in pstats}
+        for fam, key in (("fleet_trend_persist_total", "persist_total"),
+                         ("fleet_trend_persist_errors_total",
+                          "persist_errors")):
+            if deltas.get(key, 0) > 0:
+                self.metrics.count(fam, inc=float(deltas[key]))
+        self.metrics.replace_gauge_family("fleet_perf_regression",
+                                          out["gauge"])
+        for firing in out["fired"]:
+            self.metrics.count("fleet_perf_regressions_total")
+            bundle = fleet_trends.write_trend_bundle(
+                self.trends.bundle_dir,
+                firing={k: firing[k] for k in ("signal", "labels",
+                                               "value", "band", "center",
+                                               "streak", "spec")},
+                fingerprint=firing["fingerprint"],
+                window=firing.get("window") or [],
+                baseline_check=firing.get("baseline_check"))
+            if events.active():
+                events.emit("fleet_perf_regression",
+                            signal=firing["signal"],
+                            labels=firing["labels"],
+                            value=firing["value"], band=firing["band"])
+            flight.note("fleet_perf_regression", signal=firing["signal"],
+                        labels=firing["labels"], value=firing["value"])
+            if not self.cfg.quiet:
+                print(f"ict-fleet: PERF REGRESSION {firing['signal']} "
+                      f"({firing['labels'] or 'fleet'}; value "
+                      f"{firing['value']:.4g} outside {firing['band']}"
+                      f"{'; bundle ' + bundle if bundle else ''})",
+                      file=sys.stderr)
+        for rec2 in out["resolved"]:
+            if events.active():
+                events.emit("fleet_perf_regression_resolved",
+                            signal=rec2["signal"], labels=rec2["labels"],
+                            value=rec2["value"])
+            flight.note("fleet_perf_regression_resolved",
+                        signal=rec2["signal"], labels=rec2["labels"])
+            if not self.cfg.quiet:
+                print(f"ict-fleet: perf regression {rec2['signal']} "
+                      f"recovered ({rec2['labels'] or 'fleet'})",
+                      file=sys.stderr)
 
     def _trim_placements(self) -> None:
         """Bound the placement table by evicting the oldest TERMINAL
@@ -2055,13 +2212,29 @@ class FleetRouter:
                            "conservation_tolerance":
                                fleet_costs.CONSERVATION_TOLERANCE})
 
-    def fleet_metrics_history(self, ticks: int | None = None) -> dict:
+    def fleet_metrics_history(self, ticks: int | None = None,
+                              families: tuple = ()) -> dict:
         """``GET /fleet/metrics/history``: the bounded ring of per-tick
         federated expositions, lossless (each tick's families re-render
         byte-exact).  Sample values are the exposition's raw strings —
         ``+Inf``/``NaN`` spellings included — so the reply stays strict
-        JSON with no IEEE specials to stringify."""
-        return self.history.to_json(ticks=ticks)
+        JSON with no IEEE specials to stringify.  ``families`` (the
+        ``?families=`` comma-separated name-prefix filter) narrows each
+        tick to the matching families so trend/alert tooling stops
+        shipping the full exposition per tick; the filtered ticks stay
+        round-trippable through the same strict grammar."""
+        return self.history.to_json(ticks=ticks, families=families)
+
+    def fleet_trends(self, family: str = "", resolution: str = "raw",
+                     window: int | None = None) -> dict:
+        """``GET /fleet/trends``: the trend plane's fingerprint export,
+        firing regressions, bundle inventory, and — with ``?family=`` —
+        the ring data at one resolution (fleet/trends.py).  Strict JSON,
+        the ``/fleet/capacity`` IEEE-specials discipline."""
+        if self.trends is None:
+            return {"enabled": False}
+        return _json_safe(self.trends.trends_json(
+            family=family, resolution=resolution, window=window))
 
     def _recorder_tick(self) -> None:
         """Republish the recorder's gauge families and delta-feed its
@@ -2464,7 +2637,30 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 self._reply(400, {"error": "bad ?ticks= value; want an "
                                            "int >= 0"})
                 return
-            self._reply(200, router.fleet_metrics_history(ticks=ticks))
+            families = tuple(
+                p for p in str(query.get("families", [""])[0]).split(",")
+                if p)
+            self._reply(200, router.fleet_metrics_history(
+                ticks=ticks, families=families))
+        elif self.path.split("?", 1)[0] == "/fleet/trends":
+            query = urllib.parse.parse_qs(
+                urllib.parse.urlsplit(self.path).query)
+            resolution = str(query.get("resolution", ["raw"])[0])
+            try:
+                window = (int(query["window"][0])
+                          if "window" in query else None)
+                if window is not None and window < 1:
+                    raise ValueError
+            except ValueError:
+                self._reply(400, {"error": "bad ?window= value; want an "
+                                           "int >= 1"})
+                return
+            try:
+                self._reply(200, router.fleet_trends(
+                    family=str(query.get("family", [""])[0]),
+                    resolution=resolution, window=window))
+            except ValueError as exc:
+                self._reply(400, {"error": str(exc)})
         elif self.path == "/fleet/alerts":
             self._reply(200, router.fleet_alerts())
         elif self.path == "/fleet/capacity":
@@ -2838,6 +3034,42 @@ def build_fleet_parser() -> argparse.ArgumentParser:
     p.add_argument("--recorder_keep", type=int, default=16, metavar="N",
                    help="sealed trace segments retained; the oldest are "
                         "swept beyond it (default 16)")
+    p.add_argument("--no_trends", action="store_true",
+                   help="disable the durable performance-trend plane (on "
+                        "by default: multi-resolution rollup rings over "
+                        "the federated exposition persisted under "
+                        "<spool>/trends, per-bucket performance "
+                        "fingerprints, and the regression sentinel "
+                        "firing ict_fleet_perf_regression through the "
+                        "alert engine; ICT_TRENDS=0 equivalent)")
+    p.add_argument("--trend_signal", action="append", default=[],
+                   metavar="JSON",
+                   help="one fingerprint signal spec as a JSON object "
+                        "(repeatable), e.g. '{\"name\": \"warm_jobs\", "
+                        "\"mode\": \"gauge\", \"direction\": \"low\", "
+                        "\"family\": "
+                        "\"ict_fleet_capacity_replica_service_rate\", "
+                        "\"group_by\": [\"replica\"]}'; a spec re-using "
+                        "a default-set name replaces that default "
+                        '(docs/OBSERVABILITY.md "Performance trends")')
+    p.add_argument("--trend_keep_raw", type=int, default=128, metavar="N",
+                   help="raw per-tick trend points retained per series "
+                        "before the 1-minute/1-hour rollup rings take "
+                        "over (default 128)")
+    p.add_argument("--trend_sentinel_k", type=int, default=3, metavar="K",
+                   help="consecutive out-of-band windows before the "
+                        "regression sentinel fires (default 3)")
+    p.add_argument("--trend_min_samples", type=int, default=8, metavar="N",
+                   help="accepted in-band windows before a fingerprint "
+                        "arms its sentinel (default 8)")
+    p.add_argument("--trend_band_mad", type=float, default=4.0,
+                   metavar="X",
+                   help="fingerprint band half-width in MAD units "
+                        "(default 4.0)")
+    p.add_argument("--trend_persist_every", type=int, default=16,
+                   metavar="N",
+                   help="poll ticks between trend-store spool writes; "
+                        "stop() always persists (default 16)")
     p.add_argument("-q", "--quiet", action="store_true")
     p.add_argument("--smoke", action="store_true",
                    help="offline self-check: 2 in-process replicas behind "
@@ -2938,6 +3170,30 @@ def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
     if args.recorder_keep < 1:
         raise ValueError(f"--recorder_keep must be >= 1, got "
                          f"{args.recorder_keep}")
+    if args.trend_keep_raw < 1:
+        raise ValueError(f"--trend_keep_raw must be >= 1, got "
+                         f"{args.trend_keep_raw}")
+    if args.trend_sentinel_k < 1:
+        raise ValueError(f"--trend_sentinel_k must be >= 1, got "
+                         f"{args.trend_sentinel_k}")
+    if args.trend_min_samples < 2:
+        raise ValueError(f"--trend_min_samples must be >= 2 (a band "
+                         f"needs a spread), got {args.trend_min_samples}")
+    if args.trend_band_mad <= 0:
+        raise ValueError(f"--trend_band_mad must be > 0, got "
+                         f"{args.trend_band_mad}")
+    if args.trend_persist_every < 1:
+        raise ValueError(f"--trend_persist_every must be >= 1, got "
+                         f"{args.trend_persist_every}")
+    trend_signals: list[dict] = []
+    for raw in args.trend_signal:
+        try:
+            spec = json.loads(raw)
+        except ValueError as exc:
+            raise ValueError(f"bad --trend_signal JSON {raw!r}: {exc}"
+                             ) from None
+        fleet_trends.parse_signal(spec)  # validate NOW, at the CLI surface
+        trend_signals.append(spec)
     fleet_slo.parse_slo_specs(args.slo)  # validate NOW, at the CLI surface
     alert_rules: list[dict] = []
     for raw in args.alert_rule:
@@ -3007,6 +3263,13 @@ def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
         recorder=not args.no_recorder,
         recorder_segment_kb=args.recorder_segment_kb,
         recorder_keep=args.recorder_keep,
+        trends=not args.no_trends,
+        trend_keep_raw=args.trend_keep_raw,
+        trend_signals=tuple(trend_signals),
+        trend_sentinel_k=args.trend_sentinel_k,
+        trend_min_samples=args.trend_min_samples,
+        trend_band_mad=args.trend_band_mad,
+        trend_persist_every=args.trend_persist_every,
         quiet=args.quiet,
     )
 
@@ -3059,8 +3322,12 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
     duplicate archive served born-terminal by the fleet result cache, a
     late-joined third replica killed mid-campaign — and asserts
     exactly-once completion, oracle-identical masks, and a QA roll-up +
-    per-campaign cost row on the view.  One JSON line, rc 0/1 — the CI
-    lane next to ``serve --smoke``."""
+    per-campaign cost row on the view.  A trend lane (ISSUE 20) arms an
+    injected fingerprint on a synthetic speed gauge, drives a synthetic
+    slowdown through sentinel firing -> ``perf_regression`` alert ->
+    trend incident bundle -> live ``GET /fleet/trends`` view, then
+    recovery until both resolve.  One JSON line, rc 0/1 — the CI lane
+    next to ``serve --smoke``."""
     import tempfile
     import urllib.request
 
@@ -3150,6 +3417,18 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
             "slo": tuple(cfg.slo) or tuple(
                 f"{j}:0.99:64" for j in fleet_slo.JOURNEYS),
             "canary_ticks": 0,
+            # The trend lane (ISSUE 20): a synthetic per-replica speed
+            # gauge published straight into the router registry, watched
+            # by an injected fingerprint signal with a tiny arm/fire
+            # ladder — the lane below drives healthy ticks (arms),
+            # a slowdown (sentinel fires -> perf_regression alert ->
+            # trend incident bundle), then recovery (resolves).
+            "trend_signals": tuple(cfg.trend_signals) + ({
+                "name": "smoke_speed", "mode": "gauge",
+                "direction": "low",
+                "family": "ict_fleet_smoke_trend_speed",
+                "group_by": ["replica"], "window": 1,
+                "min_samples": 3, "sentinel_k": 2},),
         }))
         router.start()
         jobs = {}
@@ -3659,12 +3938,80 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                 == set(fleet_explain.PLANES)
                 and dead_exp["planes"]["zaps"]["source"] == "unavailable"
                 and dead_exp["planes"]["cost"]["source"] == "unavailable")
+            # --- the trend lane (ISSUE 20), end to end ---
+            # The injected smoke_speed fingerprint watches a synthetic
+            # router-registry gauge.  Healthy ticks arm it; a synthetic
+            # slowdown must drive sentinel firing -> the
+            # perf_regression alert (via the history ring, so one extra
+            # tick) -> a trend incident bundle on disk and a live
+            # ``GET /fleet/trends`` view of the violation; publishing
+            # the healthy figure again must resolve both.
+            def _pub_speed(v: float) -> None:
+                router.metrics.replace_gauge_family(
+                    "fleet_smoke_trend_speed",
+                    {(("replica", "smoke-a"),): v})
+
+            def _speed_firing() -> bool:
+                return (router.trends is not None
+                        and any(f["signal"] == "smoke_speed"
+                                for f in router.trends.firing()))
+
+            trend_armed = trend_fired = trend_alert = False
+            trend_resolved = trend_view_ok = trend_bundle_ok = False
+            if router.trends is not None:
+                _pub_speed(10.0)
+                deadline = time.time() + 60
+                while time.time() < deadline and not trend_armed:
+                    router.poll_tick()
+                    trend_armed = any(
+                        r["signal"] == "smoke_speed" and r["armed"]
+                        for r in router.trends.fingerprints_json()
+                        ["fingerprints"])
+                    time.sleep(0.02)
+                _pub_speed(1.0)     # the synthetic slowdown
+                deadline = time.time() + 60
+                while time.time() < deadline and not (trend_fired
+                                                      and trend_alert):
+                    router.poll_tick()
+                    trend_fired = trend_fired or _speed_firing()
+                    trend_alert = any(
+                        a["rule"] == "perf_regression"
+                        for a in router.alerts.firing())
+                    time.sleep(0.02)
+                trend_bundle_ok = any(
+                    b.get("signal") == "smoke_speed"
+                    for b in fleet_trends.list_trend_bundles(
+                        router.trends.bundle_dir))
+                trends_view = json.load(urllib.request.urlopen(
+                    f"{base}/fleet/trends?family=ict_fleet_smoke_trend"
+                    f"_speed&resolution=raw", timeout=10))
+                trend_view_ok = (
+                    trends_view.get("enabled") is not False
+                    and any(f["signal"] == "smoke_speed"
+                            for f in trends_view.get("firing", []))
+                    and len(trends_view.get("series", [])) >= 1)
+                _pub_speed(10.0)    # recovery
+                deadline = time.time() + 60
+                trend_resolved = True
+                while time.time() < deadline:
+                    router.poll_tick()
+                    if not _speed_firing() and not any(
+                            a["rule"] == "perf_regression"
+                            for a in router.alerts.firing()):
+                        break
+                    time.sleep(0.02)
+                else:
+                    trend_resolved = False
+            trends_ok = (trend_armed and trend_fired and trend_alert
+                         and trend_bundle_ok and trend_view_ok
+                         and trend_resolved)
             ok = (all_done and masks_ok and failovers >= 1
                   and done_delta == len(paths)
                   and fleet_ok and trace_ok and len(incidents) >= 1
                   and alerts_ok and coalesce_ok and cache_ok
                   and campaign_ok and canary_ok and costs_ok
                   and recorder_ok and explain_ok and explain_dead_ok
+                  and trends_ok
                   and health_b.get("audits_run", 0) >= 1
                   and health_b.get("audit_divergences", 0) == 0)
             result = {
@@ -3709,6 +4056,12 @@ def run_fleet_smoke(cfg: FleetConfig) -> int:
                 "recorder_jobs_done_unmoved": bool(rec_jobs_done_unmoved),
                 "explain_planes_ok": bool(explain_ok),
                 "explain_dead_replica_ok": bool(explain_dead_ok),
+                "trends_lane_ok": bool(trends_ok),
+                "trend_sentinel_fired": bool(trend_fired),
+                "trend_alert_fired": bool(trend_alert),
+                "trend_bundle_ok": bool(trend_bundle_ok),
+                "trend_view_ok": bool(trend_view_ok),
+                "trend_resolved": bool(trend_resolved),
                 "costs_lane_ok": bool(costs_ok),
                 "cost_conservation_ratio": (
                     round(cost_sum / dispatch_sum, 4)

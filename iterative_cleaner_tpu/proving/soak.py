@@ -459,8 +459,27 @@ def run_soak(cfg: SoakConfig) -> int:
             "cost_conservation": cost_ok,
         }
         drills_ok = bool(drills) and all(d.get("ok") for d in drills)
+        # The trend plane's view of the run (fleet/trends.py; ISSUE 20):
+        # a CLEAN soak — steady synthetic traffic, no real slowdown —
+        # must not trip the regression sentinel; a firing here means
+        # the fingerprint bands are mis-learned (or the fleet genuinely
+        # destabilized mid-proof), either of which fails the proof.
+        trends_plane = fleet.router.trends
+        trends_block = {
+            "enabled": trends_plane is not None,
+            "ticks": (trends_plane.store.ticks()
+                      if trends_plane is not None else 0),
+            "series": (trends_plane.store.series_count()
+                       if trends_plane is not None else 0),
+            "regressions_total": (trends_plane.regressions_total()
+                                  if trends_plane is not None else 0),
+            "firing": (trends_plane.firing()
+                       if trends_plane is not None else []),
+        }
+        trends_ok = (trends_block["regressions_total"] == 0
+                     and not trends_block["firing"])
         ok = (all(triad.values()) and drills_ok and replay["ok"]
-              and scen["storm_cas_ok"])
+              and scen["storm_cas_ok"] and trends_ok)
         rc = 0 if ok else 1
         fleet.verdict_code = 1.0 if ok else 2.0
         fleet.tick()   # final verdict visible on /fleet/metrics
@@ -479,6 +498,7 @@ def run_soak(cfg: SoakConfig) -> int:
         verdict.update({
             "prove": "pass" if ok else "fail",
             "triad": triad, "jobs": ledger,
+            "trends": {**trends_block, "ok": trends_ok},
             "scenario_ticks": ticks_run,
             "scenarios": dict(sorted(fleet.scenario_jobs.items())),
             "storm_cas_ok": scen["storm_cas_ok"],

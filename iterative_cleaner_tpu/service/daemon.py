@@ -637,6 +637,20 @@ class CleaningService:
                 # bounded-staleness persist instead of one atomic write
                 # per served job (obs/costs.py; flush never raises).
                 self.ctx.cost_ledger.flush()
+                # Ingest overlap efficiency as a scrapeable gauge (the
+                # trend plane's ingest_overlap fingerprint reads it off
+                # the federated exposition; the "last" hint keeps the
+                # fleet merge a max, never a sum of fractions).  Only
+                # once real pipelined blocks exist — a 0 published
+                # before any ingest would read as a regression.
+                try:
+                    from iterative_cleaner_tpu.ingest import pipeline
+                    pstats = pipeline.stats_snapshot()
+                    if pstats.get("blocks", 0) > 0:
+                        tracing.set_gauge("ingest_last_overlap_efficiency",
+                                          pstats["overlap_efficiency"])
+                except Exception:
+                    pass    # a gauge miss must never wedge the tick loop
 
     def _on_flush(self, entries) -> None:
         tracing.count("service_buckets_dispatched")

@@ -573,12 +573,8 @@ def test_fleet_capacity_endpoint_strict_json(tmp_path):
     try:
         p = _write(tmp_path, "cap.npz", seed=44)
         _post_job(router, {"path": p, "shape": [4, 16, 64]})
-        deadline = time.time() + 30
-        while time.time() < deadline:
-            router.poll_tick()
-            if router.capacity.snapshot().get("fleet", {}).get("backlog"):
-                break
-            time.sleep(0.02)
+        assert _tick_until(router, lambda: router.capacity.snapshot()
+                           .get("fleet", {}).get("backlog"))
         raw = urllib.request.urlopen(
             f"http://127.0.0.1:{router.port}/fleet/capacity",
             timeout=10).read().decode()

@@ -622,7 +622,9 @@ def test_router_alert_lifecycle_e2e(tmp_path):
         # /fleet/alerts: firing + rules table + bundle inventory
         view = _get(router, "/fleet/alerts")
         assert [a["rule"] for a in view["firing"]] == ["alive_watch"]
-        assert view["rules"][0]["firing_series"] == 1
+        rule_row = next(r for r in view["rules"]
+                        if r["name"] == "alive_watch")
+        assert rule_row["firing_series"] == 1
         assert view["bundles"] and view["bundles"][0]["rule"] == \
             "alive_watch"
         assert view["sinks"] == {"webhook": False, "cmd": False}

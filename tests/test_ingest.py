@@ -319,6 +319,10 @@ class TestPerfGateIngestContract:
         # The flight-recorder block (ISSUE 19): a bare {} would
         # (correctly) fail the "no overhead_frac" check.
         payload["recorder"] = {"overhead_frac": 0.01}
+        # The trend-plane block (ISSUE 20): needs overhead_frac, a live
+        # on-arm plane, and zero sentinel firings on a clean bench.
+        payload["trends"] = {"overhead_frac": 0.01, "trended_on": True,
+                             "regressions_total": 0}
         payload["donation_ledger"] = dict(base["donation_ledger"])
         assert pg.compare(payload, base, 3.0, 1.15) == []
 

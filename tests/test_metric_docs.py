@@ -64,6 +64,14 @@ CONDITIONAL_FAMILIES = {
     "ict_rfi_zaps_attributed_total",   # needs ICT_FORENSICS=1 timelines
     "ict_fleet_replica_bucket_queue_depth",  # needs cubes PARKED at the
                                        # instant of a health poll
+    # the trend plane's per-series regression gauge: a {signal, key}
+    # series exists only once a fingerprint ARMS (>= --trend_min_samples
+    # accepted windows), which this short-lived mini-fleet never reaches
+    "ict_fleet_perf_regression",
+    # the daemon publishes ingest overlap only after pipelined ingest
+    # blocks exist (blocks > 0); this mini-fleet's small jobs load
+    # in-line, never through the staging pipeline
+    "ict_ingest_last_overlap_efficiency",
     # proving-ground gauges: only published while an ``ict-clean prove``
     # soak is driving the router (docs/PROVING.md)
     "ict_prove_scenario_jobs",
@@ -147,15 +155,30 @@ def test_documented_families_exist_live(tmp_path):
         while (svc.scheduler.pending_count() < 1
                and time.time() < deadline):
             time.sleep(0.02)
+        assert svc.scheduler.pending_count() >= 1, (
+            "direct submission never reached the scheduler")
         svc.scheduler.flush_all()
+        rec = None
         deadline = time.time() + 120
         while time.time() < deadline:
             rec = svc.job(direct.id)
             if rec is not None and rec.state in TERMINAL:
                 break
             time.sleep(0.05)
+        assert rec is not None and rec.state in TERMINAL, (
+            f"direct job never terminal: "
+            f"{rec.state if rec is not None else None!r}")
         svc.auditor.drain(60)
-        time.sleep(0.3)   # one tick-loop pass: RSS/spool-disk gauges
+        # Bounded wait for one tick-loop gauge pass (RSS + spool disk)
+        # instead of a blind sleep — the cold-run flake class.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if "ict_host_rss_bytes" in _live_names(
+                    [_http_text(f"http://127.0.0.1:{svc.port}/metrics")]):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("tick-loop gauges never published")
         for _ in range(2):
             router.poll_tick()
         live = _live_names([

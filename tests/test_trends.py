@@ -1,0 +1,629 @@
+"""The durable performance-trend plane (fleet/trends.py; ISSUE 20).
+
+Four layers, cheapest first:
+
+- rollup math: the cell monoid's cross-boundary exactness (merged 1m
+  cells == the 1h cell built directly from the raw points, counter
+  deltas conserved through the merge) and per-tier ring rollover;
+- persistence: dump -> load -> dump byte-identity (the restart story),
+  foreign-version refusal;
+- fingerprint/sentinel: arm at min_samples, fire exactly on the Kth
+  consecutive out-of-band window, center/MAD freeze while violating,
+  resolve on the first in-band window, freeze-on-missing gauge keys;
+- end to end: a dormant router driven tick by tick through the full
+  arm -> fire -> alert -> bundle -> resolve drill, plus the
+  ``?families=`` history filter round-trip and the CLI validation
+  surface.
+
+No sleeps anywhere: the router is started dormant
+(``poll_interval_s=999``) and every tick is driven by hand, so the
+drill is deterministic.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from test_fleet import _get, _start_router
+from iterative_cleaner_tpu.fleet import history as fleet_history
+from iterative_cleaner_tpu.fleet import trends as fleet_trends
+from iterative_cleaner_tpu.fleet.trends import (
+    Fingerprint,
+    SignalSpec,
+    TrendConfig,
+    TrendPlane,
+    TrendStore,
+    cell_add,
+    cell_new,
+    cell_reading,
+    merge_cells,
+    parse_signal,
+)
+from iterative_cleaner_tpu.obs import metrics as obs_metrics
+
+
+def _fam(name, kind, samples):
+    fam = obs_metrics.MetricFamily(name=name, kind=kind)
+    fam.samples = list(samples)
+    return fam
+
+
+def _gauge(name, value, **labels):
+    lp = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+    return _fam(name, "gauge", [(name, lp, repr(float(value)))])
+
+
+def _counter(name, value, **labels):
+    lp = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+    return _fam(name, "counter", [(name, lp, repr(float(value)))])
+
+
+# --- rollup math ---------------------------------------------------------
+
+
+def test_merge_cells_equals_direct_coarse_cell():
+    """The monoid law the docs pin: folding raw points into 1-minute
+    cells and merging those into an hour cell must equal the hour cell
+    built directly from the same points — exact, field for field."""
+    points = [(float(t), 100.0 + 7.0 * ((t // 60) % 5) + 0.25 * (t % 60))
+              for t in range(0, 3 * 60 * 60, 13)]
+    minute_cells, direct_hours = [], {}
+    cur = None
+    for ts, v in points:
+        t0 = int(ts // 60) * 60
+        if cur is not None and cur["t0"] != t0:
+            minute_cells.append(cur)
+            cur = None
+        if cur is None:
+            cur = cell_new(ts, v, 60)
+        else:
+            cell_add(cur, v)
+        h0 = int(ts // 3600) * 3600
+        if h0 not in direct_hours:
+            direct_hours[h0] = cell_new(ts, v, 3600)
+        else:
+            cell_add(direct_hours[h0], v)
+    minute_cells.append(cur)
+    for h0, direct in sorted(direct_hours.items()):
+        fine = [c for c in minute_cells if int(c["t0"] // 3600) * 3600 == h0]
+        assert merge_cells(fine, 3600) == direct
+
+
+def test_merge_conserves_counter_delta():
+    """A counter's in-cell delta (``last - first``) must survive the
+    1m -> 1h merge exactly: the merged cell reads the same delta as the
+    directly-built coarse cell."""
+    points = [(float(t), 1000.0 + 3.0 * i)
+              for i, t in enumerate(range(0, 3600, 10))]
+    cells, cur = [], None
+    for ts, v in points:
+        t0 = int(ts // 60) * 60
+        if cur is not None and cur["t0"] != t0:
+            cells.append(cur)
+            cur = None
+        cur = cell_new(ts, v, 60) if cur is None else (cell_add(cur, v)
+                                                       or cur)
+    cells.append(cur)
+    merged = merge_cells(cells, 3600)
+    assert cell_reading(merged, "counter") == points[-1][1] - points[0][1]
+    assert merged["n"] == len(points)
+    assert merged["min"] == points[0][1] and merged["max"] == points[-1][1]
+
+
+def test_store_rollup_matches_merge_across_hour_boundary():
+    """Store-level twin of the monoid law: feed one gauge series through
+    ``TrendStore.append`` across an hour boundary and require the 3600s
+    tier to equal ``merge_cells`` over the 60s tier, hour by hour."""
+    store = TrendStore(keep_raw=4096)
+    for i in range(150):   # 2.5 h at one tick/min
+        ts = 30.0 + 60.0 * i
+        store.append([_gauge("ict_fleet_probe_speed", 50.0 + (i % 7),
+                             replica="a")], ts)
+    [sixty] = store.query(family="ict_fleet_probe_speed", resolution="60")
+    [hour] = store.query(family="ict_fleet_probe_speed", resolution="3600")
+    by_hour = {}
+    for cell in sixty["cells"]:
+        by_hour.setdefault(int(cell["t0"] // 3600) * 3600, []).append(cell)
+    assert len(hour["cells"]) == len(by_hour)
+    for got in hour["cells"]:
+        assert got == merge_cells(by_hour[got["t0"]], 3600)
+
+
+def test_ring_rollover_per_tier():
+    """Each tier is bounded by construction: raw at ``keep_raw``, the
+    60s ring at 360 sealed cells, the 3600s ring at 168."""
+    store = TrendStore(keep_raw=128)
+    for i in range(400):   # one 60s bucket per tick
+        store.append([_gauge("ict_fleet_probe_speed", float(i),
+                             replica="a")], 60.0 * i)
+    [row] = store.inventory()
+    assert row["raw_points"] == 128
+    assert row["cells"]["60s"] == 360 + 1        # ring-full sealed + open
+    assert row["cells"]["3600s"] == 6 + 1        # 400 min ≈ 6.7 h
+
+    store = TrendStore(keep_raw=8)
+    for i in range(200):   # one 3600s bucket per tick
+        store.append([_gauge("ict_fleet_probe_speed", float(i),
+                             replica="a")], 3600.0 * i)
+    [row] = store.inventory()
+    assert row["raw_points"] == 8
+    assert row["cells"]["3600s"] == 168 + 1
+
+
+def test_store_skips_untracked_and_non_finite():
+    store = TrendStore()
+    store.append([
+        _gauge("ict_fleet_probe_speed", 1.0, replica="a"),
+        _gauge("ict_other_family", 1.0),                    # untracked
+        _fam("ict_fleet_bad", "gauge",
+             [("ict_fleet_bad", (), "NaN"),
+              ("ict_fleet_bad", (("k", "v"),), "+Inf")]),   # IEEE noise
+    ], 10.0)
+    assert store.series_count() == 1
+    assert store.ticks() == 1
+
+
+def test_delta_sum_clamps_counter_resets():
+    store = TrendStore()
+    for i, v in enumerate([100.0, 110.0, 5.0]):   # reset between ticks
+        store.append([_counter("ict_fleet_probe_total", v, replica="a")],
+                     float(i))
+    got = store.delta_sum("ict_fleet_probe_total", (), ("replica",), 8)
+    assert got == {(("replica", "a"),): 0.0}      # clamped, never negative
+    store.append([_counter("ict_fleet_probe_total", 9.0, replica="a")], 3.0)
+    got = store.delta_sum("ict_fleet_probe_total", (), ("replica",), 1)
+    assert got == {(("replica", "a"),): 4.0}
+
+
+# --- persistence ---------------------------------------------------------
+
+
+def _speed_spec(**kw):
+    base = dict(name="speed", mode="gauge", direction="low",
+                family="ict_fleet_probe_speed", group_by=("replica",),
+                window=1, min_samples=3, sentinel_k=2)
+    base.update(kw)
+    return SignalSpec(**base)
+
+
+def test_restart_rehydration_byte_identical(tmp_path):
+    """The acceptance bar verbatim: kill/restart (new plane, same spool)
+    must rehydrate rings AND fingerprint state; re-persisting without a
+    tick in between must reproduce the spool file byte for byte."""
+    cfg = TrendConfig(spool_dir=str(tmp_path), signals=(_speed_spec(),),
+                      persist_every=1)
+    plane = TrendPlane(cfg)
+    for i in range(6):
+        plane.tick([_gauge("ict_fleet_probe_speed", 10.0 + 0.1 * i,
+                           replica="a")], 100.0 + 60.0 * i)
+    assert plane.persist(force=True)
+    with open(plane.store_path, "rb") as fh:
+        first = fh.read()
+
+    reborn = TrendPlane(cfg)
+    assert reborn.store.ticks() == plane.store.ticks()
+    assert reborn.fingerprints_json() == plane.fingerprints_json()
+    assert reborn.persist(force=True)
+    with open(reborn.store_path, "rb") as fh:
+        assert fh.read() == first
+
+
+def test_rehydration_survives_corrupt_and_foreign_spool(tmp_path):
+    cfg = TrendConfig(spool_dir=str(tmp_path), signals=(_speed_spec(),))
+    path = os.path.join(str(tmp_path), "trends", "trends.json")
+    os.makedirs(os.path.dirname(path))
+    with open(path, "w") as fh:
+        fh.write("{not json")
+    plane = TrendPlane(cfg)          # tolerant: boots fresh, no raise
+    assert plane.store.ticks() == 0
+    with pytest.raises(ValueError, match="version"):
+        TrendStore().load_json({"version": 999, "series": []})
+
+
+# --- fingerprint / sentinel ----------------------------------------------
+
+
+_PARAMS = dict(direction="low", min_samples=3, sentinel_k=2,
+               band_mad=4.0, rel_floor=0.05)
+
+
+def test_fingerprint_arms_at_min_samples():
+    fp = Fingerprint()
+    for i in range(3):
+        edge = fp.observe(10.0 + 0.01 * i, **_PARAMS)
+        assert edge == {"armed": i >= 3, "violating": False,
+                        "fired": False, "resolved": False}
+    assert fp.observe(10.0, **_PARAMS)["armed"] is True
+    assert fp.band(4.0, 0.05) is not None
+
+
+def test_sentinel_fires_on_kth_window_and_center_freezes():
+    fp = Fingerprint()
+    for _ in range(4):
+        fp.observe(10.0, **_PARAMS)
+    center, n = fp.center, fp.n
+    e1 = fp.observe(1.0, **_PARAMS)
+    assert e1["violating"] and not e1["fired"] and fp.streak == 1
+    # Freeze: a violating figure must not teach the fingerprint.
+    assert fp.center == center and fp.n == n
+    e2 = fp.observe(1.0, **_PARAMS)
+    assert e2["fired"] and fp.firing and fp.streak == 2
+    assert fp.center == center and fp.n == n
+    # The edge fires once; staying bad keeps firing without a new edge.
+    e3 = fp.observe(1.0, **_PARAMS)
+    assert not e3["fired"] and fp.firing
+    # First in-band window resolves AND is accepted again.
+    e4 = fp.observe(10.0, **_PARAMS)
+    assert e4["resolved"] and not fp.firing and fp.streak == 0
+    assert fp.n == n + 1
+
+
+def test_sentinel_direction_high_and_both():
+    fp = Fingerprint()
+    params = dict(_PARAMS, direction="high")
+    for _ in range(4):
+        fp.observe(10.0, **params)
+    assert not fp.observe(1.0, **params)["violating"]   # low is fine
+    assert fp.observe(100.0, **params)["violating"]
+    fp = Fingerprint()
+    params = dict(_PARAMS, direction="both")
+    for _ in range(4):
+        fp.observe(10.0, **params)
+    assert fp.observe(1.0, **params)["violating"]
+    assert fp.observe(100.0, **params)["violating"]
+
+
+def test_band_uses_relative_floor_over_tiny_mad():
+    """Identical samples give MAD 0 — the band must fall back to the
+    relative floor, not collapse to zero width."""
+    fp = Fingerprint()
+    for _ in range(4):
+        fp.observe(10.0, **_PARAMS)
+    lo, hi = fp.band(4.0, 0.05)
+    assert lo == pytest.approx(10.0 - 4.0 * 0.5)
+    assert hi == pytest.approx(10.0 + 4.0 * 0.5)
+
+
+def test_plane_sentinel_drill_and_gauge_freeze_on_missing(tmp_path):
+    """Plane-level drill: arm -> fire -> resolve through ``tick``, and
+    the regression gauge must keep the recovered key PRESENT at 0.0
+    (resolution is a value, never an absence — the alert engine freezes
+    on missing series)."""
+    plane = TrendPlane(TrendConfig(signals=(_speed_spec(),)))
+    key = (("signal", "speed"), ("replica", "a"))
+
+    def tick(v, i):
+        return plane.tick([_gauge("ict_fleet_probe_speed", v,
+                                  replica="a")], 100.0 + 60.0 * i)
+
+    for i in range(4):
+        out = tick(10.0, i)
+        assert not out["fired"] and not out["resolved"]
+    out = tick(1.0, 4)
+    assert not out["fired"]
+    out = tick(1.0, 5)
+    assert [f["signal"] for f in out["fired"]] == ["speed"]
+    assert out["fired"][0]["labels"] == {"replica": "a"}
+    assert out["gauge"][key] == 1.0
+    assert plane.regressions_total() == 1
+    assert [f["signal"] for f in plane.firing()] == ["speed"]
+    out = tick(10.0, 6)
+    assert [r["signal"] for r in out["resolved"]] == ["speed"]
+    assert out["gauge"][key] == 0.0       # present at zero, not dropped
+    assert plane.firing() == []
+    assert plane.regressions_total() == 1
+
+
+def test_ratio_delta_and_hist_quantile_figures():
+    hit_spec = SignalSpec(name="hit_rate", mode="ratio_delta",
+                          direction="low",
+                          num_family="ict_fleet_probe_total",
+                          num_labels=(("outcome", "hit"),),
+                          den_family="ict_fleet_probe_total", window=4)
+    p50_spec = SignalSpec(name="p50", mode="hist_quantile",
+                          direction="high", family="ict_fleet_probe_lat",
+                          q=0.5, window=4)
+    plane = TrendPlane(TrendConfig(signals=(hit_spec, p50_spec)))
+    for i in range(3):
+        hits, miss = 10.0 * i, 30.0 * i
+        buckets = [("ict_fleet_probe_lat_bucket", (("le", "0.1"),),
+                    repr(4.0 * i)),
+                   ("ict_fleet_probe_lat_bucket", (("le", "1.0"),),
+                    repr(6.0 * i)),
+                   ("ict_fleet_probe_lat_bucket", (("le", "+Inf"),),
+                    repr(8.0 * i))]
+        plane.tick([
+            _counter("ict_fleet_probe_total", hits, outcome="hit"),
+            _counter("ict_fleet_probe_total", miss, outcome="miss"),
+            _fam("ict_fleet_probe_lat", "histogram", buckets),
+        ], 100.0 + float(i))
+    figs = plane._figures(hit_spec)
+    assert figs == {(): pytest.approx(20.0 / 80.0)}
+    figs = plane._figures(p50_spec)
+    assert figs[()] == pytest.approx(
+        obs_metrics.quantile_from_cum({0.1: 8.0, 1.0: 12.0,
+                                       float("inf"): 16.0}, 0.5))
+
+
+def test_baseline_cross_check(tmp_path):
+    base = tmp_path / "baseline.json"
+    base.write_text(json.dumps({"ingest": {"overlap_efficiency": 0.8}}))
+    spec = _speed_spec(baseline_key="ingest.overlap_efficiency")
+    plane = TrendPlane(TrendConfig(signals=(spec,),
+                                   baseline_path=str(base)))
+    good = plane._baseline_check(spec, 0.7)
+    assert good == {"baseline_key": "ingest.overlap_efficiency",
+                    "baseline": 0.8, "live": 0.7,
+                    "machine_independent": True, "within_2x": True}
+    assert plane._baseline_check(spec, 0.1)["within_2x"] is False
+    # honesty over coverage: no key / no file -> None, never a guess
+    assert plane._baseline_check(_speed_spec(), 0.1) is None
+    plane = TrendPlane(TrendConfig(signals=(spec,)))
+    assert plane._baseline_check(spec, 0.1) is None
+
+
+def test_trend_bundle_write_and_list(tmp_path):
+    d = str(tmp_path / "bundles")
+    firing = {"signal": "speed", "labels": {"replica": "a"}, "value": 1.0,
+              "band": [9.0, 11.0], "center": 10.0, "streak": 2,
+              "spec": _speed_spec().to_json()}
+    path = fleet_trends.write_trend_bundle(
+        d, firing=firing, fingerprint=Fingerprint().to_json(),
+        window=[{"family": "ict_fleet_probe_speed", "points": []}],
+        baseline_check=None)
+    assert path and os.path.isdir(path)
+    assert not [n for n in os.listdir(d) if n.endswith(".part")]
+    with open(os.path.join(path, "manifest.json")) as fh:
+        manifest = json.load(fh)
+    assert manifest["reason"] == "perf_regression"
+    assert manifest["firing"]["signal"] == "speed"
+    [row] = fleet_trends.list_trend_bundles(d)
+    assert row["path"] == path and row["signal"] == "speed"
+    assert row["labels"] == {"replica": "a"}
+
+
+# --- spec validation, rules, rendering -----------------------------------
+
+
+def test_parse_signal_validation():
+    good = parse_signal({"name": "s", "mode": "gauge", "family": "ict_x"})
+    assert good.name == "s" and good.window == 8
+    for bad, match in [
+        ({"mode": "gauge", "family": "ict_x"}, "non-empty 'name'"),
+        ({"name": "s", "mode": "bogus", "family": "f"}, "mode must be"),
+        ({"name": "s", "mode": "gauge", "family": "f",
+          "direction": "up"}, "direction must be"),
+        ({"name": "s", "mode": "ratio_delta",
+          "num_family": "n"}, "num_family.*den_family"),
+        ({"name": "s", "mode": "gauge"}, "needs 'family'"),
+        ({"name": "s", "mode": "gauge", "family": "f",
+          "window": 0}, "window must be"),
+        ({"name": "s", "mode": "hist_quantile", "family": "f",
+          "q": 1.5}, "q must be"),
+        ({"name": "s", "mode": "gauge", "family": "f",
+          "labels": "oops"}, "must be an object"),
+        ("not-a-dict", "JSON object"),
+    ]:
+        with pytest.raises(ValueError, match=match):
+            parse_signal(bad)
+
+
+def test_default_signals_parse_and_trend_rule():
+    for spec in fleet_trends.default_signals():
+        assert spec.mode in fleet_trends.SIGNAL_MODES
+        assert parse_signal(spec.to_json()) == spec   # JSON round-trip
+    [rule] = fleet_trends.trend_rules()
+    assert rule.name == "perf_regression" and rule.source == "trend"
+    assert rule.family == "ict_fleet_perf_regression"
+    assert rule.severity == "critical"
+
+
+def test_sparkline_and_render():
+    assert fleet_trends.sparkline([]) == ""
+    assert fleet_trends.sparkline([5.0, 5.0, 5.0]) == "▄▄▄"  # flat mid
+    line = fleet_trends.sparkline(list(range(8)))
+    assert line[0] == "▁" and line[-1] == "█" and len(line) == 8
+    assert len(fleet_trends.sparkline(list(range(100)))) == 24
+    plane = TrendPlane(TrendConfig(signals=(_speed_spec(),)))
+    for i in range(4):
+        plane.tick([_gauge("ict_fleet_probe_speed", 10.0, replica="a")],
+                   100.0 + float(i))
+    text = fleet_trends.render_trends(plane.trends_json())
+    assert "speed" in text and "replica=a" in text
+
+
+def test_cli_flag_validation():
+    from iterative_cleaner_tpu.fleet.router import (
+        build_fleet_parser, fleet_config_from_args)
+    parser = build_fleet_parser()
+    base = ["--replica", "http://127.0.0.1:1"]
+
+    def cfg(*extra):
+        return fleet_config_from_args(parser.parse_args(base + list(extra)))
+
+    got = cfg("--trend_sentinel_k", "5", "--trend_min_samples", "4",
+              "--trend_signal", json.dumps(
+                  {"name": "s", "mode": "gauge", "family": "ict_x"}))
+    assert got.trends and got.trend_sentinel_k == 5
+    assert got.trend_min_samples == 4
+    assert got.trend_signals[0]["name"] == "s"
+    assert cfg("--no_trends").trends is False
+    for extra, match in [
+        (("--trend_keep_raw", "0"), "trend_keep_raw"),
+        (("--trend_sentinel_k", "0"), "trend_sentinel_k"),
+        (("--trend_min_samples", "1"), "needs a spread"),
+        (("--trend_band_mad", "0"), "trend_band_mad"),
+        (("--trend_persist_every", "0"), "trend_persist_every"),
+        (("--trend_signal", "{not json"), "bad --trend_signal JSON"),
+        (("--trend_signal", '{"name": "s", "mode": "bogus"}'),
+         "mode must be"),
+    ]:
+        with pytest.raises(ValueError, match=match):
+            cfg(*extra)
+
+
+# --- end to end: the router drill + the ?families= filter ----------------
+
+
+DRILL_SPEC = {"name": "drill_speed", "mode": "gauge", "direction": "low",
+              "family": "ict_fleet_drill_speed", "group_by": ["replica"],
+              "window": 1, "min_samples": 3, "sentinel_k": 2}
+
+
+def _drive(router, pred, max_ticks=60):
+    """Drive dormant-router poll ticks until ``pred()`` — deterministic,
+    no wall-clock waits (the bounded-wait idiom, tick-driven)."""
+    for _ in range(max_ticks):
+        if pred():
+            return True
+        router.poll_tick()
+    return pred()
+
+
+def test_router_regression_drill_end_to_end(tmp_path):
+    """The ISSUE's e2e acceptance drill: a synthetic per-replica speed
+    gauge arms a fingerprint, a slowdown fires the sentinel (gauge,
+    alert, bundle, HTTP view), recovery resolves everything, and a
+    restarted plane rehydrates the learned state byte-identically."""
+    router = _start_router(
+        replicas=("http://127.0.0.1:1",),   # no live replica needed
+        trend_signals=(DRILL_SPEC,),
+        spool_dir=str(tmp_path / "spool"), trend_persist_every=1)
+    try:
+        plane = router.trends
+
+        def pub(v):
+            router.metrics.replace_gauge_family(
+                "fleet_drill_speed", {(("replica", "drill-a"),): v})
+
+        def fp_row():
+            rows = plane.fingerprints_json()["fingerprints"]
+            return rows[0] if rows else {}
+
+        pub(10.0)
+        assert _drive(router, lambda: fp_row().get("armed")), (
+            "fingerprint never armed on healthy traffic")
+        assert not fp_row()["firing"]
+        assert plane.regressions_total() == 0
+
+        pub(1.0)
+        assert _drive(router, lambda: fp_row().get("firing")), (
+            "sentinel never fired on the synthetic slowdown")
+        assert plane.regressions_total() == 1
+        # Freeze: the center must still describe the healthy figure.
+        assert fp_row()["center"] > 5.0
+        # The gauge + the alert bridge (fires one tick after the gauge).
+        key = (("signal", "drill_speed"), ("replica", "drill-a"))
+        assert plane.gauge_family()[key] == 1.0
+        assert _drive(router, lambda: router.alerts.firing_counts().get(
+            "perf_regression", 0) >= 1), "perf_regression alert never fired"
+        assert router.metrics.counter_value(
+            "fleet_perf_regressions_total") == 1.0
+        # Bundle on disk with the offending window.
+        [bundle] = fleet_trends.list_trend_bundles(plane.bundle_dir)
+        assert bundle["signal"] == "drill_speed"
+        with open(os.path.join(bundle["path"], "window.json")) as fh:
+            window = json.load(fh)
+        assert any(row["family"] == "ict_fleet_drill_speed"
+                   for row in window["series"])
+        # The live HTTP views.
+        body = _get(router, "/fleet/trends")
+        assert body["enabled"] and body["regressions_total"] == 1
+        assert [f["signal"] for f in body["firing"]] == ["drill_speed"]
+        assert body["fingerprints"]["grammar"] == "ict-fingerprints"
+        assert body["bundles"][0]["name"] == bundle["name"]
+        assert "inventory" in body and "series" not in body
+        narrowed = _get(router, "/fleet/trends?family=ict_fleet_drill"
+                                "&resolution=raw&window=8")
+        assert narrowed["series"] and all(
+            s["family"].startswith("ict_fleet_drill")
+            for s in narrowed["series"])
+        assert _get(router, "/fleet/trends?family=ict_fleet_drill"
+                            "&resolution=5s", expect_error=True) == 400
+        assert _get(router, "/fleet/trends?window=0",
+                    expect_error=True) == 400
+
+        # Recovery: resolve the sentinel, the gauge stays present at 0.
+        pub(10.0)
+        assert _drive(router, lambda: not fp_row().get("firing")), (
+            "sentinel never resolved after recovery")
+        assert plane.gauge_family()[key] == 0.0
+        assert _drive(router, lambda: router.alerts.firing_counts().get(
+            "perf_regression", 0) == 0), "alert never resolved"
+        assert plane.regressions_total() == 1
+
+        # Restart byte-identity, with the learned fingerprints on board.
+        assert plane.persist(force=True)
+        with open(plane.store_path, "rb") as fh:
+            first = fh.read()
+        reborn = TrendPlane(plane.cfg)
+        assert reborn.fingerprints_json() == plane.fingerprints_json()
+        assert reborn.persist(force=True)
+        with open(reborn.store_path, "rb") as fh:
+            assert fh.read() == first
+    finally:
+        router.stop()
+
+
+def test_history_families_filter_roundtrip():
+    """Satellite 1: ``?families=`` must subset each tick (original
+    family order kept, prefix semantics, comma-separated ORs) and the
+    filtered families must re-render byte-exact — the same lossless
+    grammar, smaller wire cost."""
+    router = _start_router(replicas=("http://127.0.0.1:1",))
+    try:
+        for _ in range(3):
+            router.poll_tick()
+        full = _get(router, "/fleet/metrics/history")
+        filt = _get(router, "/fleet/metrics/history"
+                            "?families=ict_fleet_trend,ict_fleet_jobs")
+        assert [t["tick"] for t in filt["ticks"]] == [
+            t["tick"] for t in full["ticks"]]
+        prefixes = ("ict_fleet_trend", "ict_fleet_jobs")
+        for got, want in zip(filt["ticks"], full["ticks"]):
+            assert got["ts"] == want["ts"]
+            expect = [f for f in want["families"]
+                      if f["name"].startswith(prefixes)]
+            assert got["families"] == expect
+            assert expect, "filter matched nothing — dead prefixes?"
+            rendered = obs_metrics.render_exposition(
+                [fleet_history.family_from_json(f)
+                 for f in got["families"]])
+            want_rendered = obs_metrics.render_exposition(
+                [fleet_history.family_from_json(f) for f in expect])
+            assert rendered == want_rendered
+        # No filter and a blank filter are the full reply.
+        assert _get(router, "/fleet/metrics/history?families=")[
+            "ticks"] == full["ticks"]
+        # ?ticks= composes with ?families=.
+        one = _get(router, "/fleet/metrics/history"
+                           "?ticks=1&families=ict_fleet_trend")
+        assert len(one["ticks"]) == 1
+        assert all(f["name"].startswith("ict_fleet_trend")
+                   for f in one["ticks"][0]["families"])
+    finally:
+        router.stop()
+
+
+def test_router_trends_disabled_surface(monkeypatch, tmp_path):
+    """ICT_TRENDS=0 keeps every surface honest: no plane, the enabled
+    gauge at 0, ``/fleet/trends`` answering ``{"enabled": false}``."""
+    monkeypatch.setenv("ICT_TRENDS", "0")
+    router = _start_router(replicas=("http://127.0.0.1:1",),
+                            spool_dir=str(tmp_path / "spool"))
+    try:
+        assert router.trends is None
+        router.poll_tick()
+        live = {name: raw
+                for fam in obs_metrics.parse_exposition(
+                    router.metrics.render())
+                for name, _labels, raw in fam.samples}
+        assert obs_metrics.sample_value(
+            live["ict_fleet_trend_enabled"]) == 0.0
+        assert _get(router, "/fleet/trends") == {"enabled": False}
+    finally:
+        router.stop()

@@ -16,9 +16,13 @@ section off the proving ground's ``ict_prove_*`` gauges when an
 SLO section off the SLI/error-budget plane (``GET /fleet/slo``:
 per-journey availability/correctness, p99 latency, budget remaining,
 burn rates, and the canary prober's round count — docs/OBSERVABILITY.md
-"Canary probing & SLOs"), a RECORDER line off the production flight
-recorder's segment inventory (``GET /fleet/traces``: sealed segments,
-bytes, open tape, entry/excluded/dropped tallies), and a
+"Canary probing & SLOs"), a TREND section off the durable
+performance-trend plane (``GET /fleet/trends``: fingerprint table with
+learned centers/bands, per-series sparklines, firing regressions —
+docs/OBSERVABILITY.md "Performance trends & regression sentinel"), a
+RECORDER line off the production flight recorder's segment inventory
+(``GET /fleet/traces``: sealed segments, bytes, open tape,
+entry/excluded/dropped tallies), and a
 FIRING ALERTS section off the alerting plane.  ``fleet_top.py explain
 <job_id>`` is a one-shot mode instead: it prints the per-job causal
 report off ``GET /fleet/explain/<job_id>`` (the same renderer as
@@ -40,6 +44,7 @@ import json
 import sys
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 
 
@@ -82,6 +87,33 @@ def collect(base: str, timeout_s: float = 10.0) -> dict:
         traces = _get_json(base, "/fleet/traces", timeout_s)
     except (urllib.error.URLError, OSError, ValueError):
         traces = {}   # pre-recorder routers still render everything else
+    # The trend plane (GET /fleet/trends): the unfiltered reply is a
+    # bounded inventory + fingerprint table; the sparkline rings are
+    # fetched per signal family (a handful of narrow queries) so the
+    # snapshot never ships every retained series.
+    try:
+        trends = _get_json(base, "/fleet/trends", timeout_s)
+    except (urllib.error.URLError, OSError, ValueError):
+        trends = {}   # pre-trend routers still render everything else
+    if trends.get("enabled"):
+        spark_fams: list[str] = []
+        for spec in (trends.get("fingerprints") or {}).get("signals") or []:
+            for key in ("family", "num_family"):
+                fam_name = spec.get(key)
+                if fam_name and fam_name not in spark_fams:
+                    spark_fams.append(fam_name)
+        series: list[dict] = []
+        for fam_name in spark_fams[:6]:
+            try:
+                sub = _get_json(
+                    base,
+                    "/fleet/trends?family="
+                    f"{urllib.parse.quote(fam_name)}"
+                    "&resolution=raw&window=32", timeout_s)
+                series.extend(sub.get("series") or [])
+            except (urllib.error.URLError, OSError, ValueError):
+                pass
+        trends["series"] = series
     p50s: dict[str, float] = {}
     scale_events = 0.0
     # bucket -> {k -> dispatch count} (the merged fleet-wide coalesce
@@ -153,6 +185,7 @@ def collect(base: str, timeout_s: float = 10.0) -> dict:
         "fleet_cache": health.get("result_cache") or {},
         "campaigns": health.get("campaigns") or {},
         "slo": slo,
+        "trends": trends,
         "recorder": traces.get("recorder") or {},
         "soak": ({"scenarios": soak_scenarios, "faults": soak_faults,
                   "verdict": soak_verdict,
@@ -258,6 +291,7 @@ def render(snap: dict) -> str:
     lines += render_tenants(snap.get("costs") or {})
     lines += render_soak(snap.get("soak") or {})
     lines += render_slo(snap.get("slo") or {})
+    lines += render_trend_section(snap.get("trends") or {})
     fleet = capacity.get("fleet", {})
     if fleet:
         fc = snap.get("fleet_cache") or {}
@@ -412,6 +446,21 @@ def render_slo(slo: dict) -> list[str]:
             f"{_fmt_num(burn.get('fast')):>7} "
             f"{_fmt_num(burn.get('slow')):>7}")
     return lines
+
+
+def render_trend_section(trends: dict) -> list[str]:
+    """The TREND section (from ``GET /fleet/trends``): the fingerprint
+    table with learned centers/bands and per-series sparklines (rings
+    fetched per signal family in :func:`collect`), plus any firing
+    regressions — rendered through the same
+    ``fleet.trends.render_trends`` the ``ict-clean trends`` one-shot
+    uses (docs/OBSERVABILITY.md "Performance trends & regression
+    sentinel").  Empty (section absent) when the router predates the
+    trend plane or runs with it disabled."""
+    if not trends or not trends.get("enabled"):
+        return []
+    from iterative_cleaner_tpu.fleet import trends as fleet_trends
+    return ["", "TREND", fleet_trends.render_trends(trends)]
 
 
 def render_recorder(rec: dict) -> list[str]:

@@ -117,6 +117,8 @@ GATE_ENV = {
     # puts the timed window near a second and the overhead fraction
     # inside the collapse ratchet's headroom.
     "BENCH_RECORDER_K": "48",
+    # Same noise-floor reasoning for the trend-plane arms (ISSUE 20).
+    "BENCH_TRENDS_K": "48",
     "BENCH_WATCHDOG_S": "900",
     "ICT_NO_COMPILE_CACHE": "1",
 }
@@ -147,7 +149,7 @@ STATIC_KEYS = ("step_dense_bytes_cubes", "step_incremental_bytes_cubes",
 #: throughput + content-cache round-trip, parity-flagged).
 REQUIRED_KEYS = ("metric", "value", "unit", "vs_baseline",
                  "compile_accounting", "memory", "audit", "ingest",
-                 "coalesce", "costs", "fleet", "recorder")
+                 "coalesce", "costs", "fleet", "recorder", "trends")
 
 #: The tentpole's acceptance bar: the baseline must have demonstrated
 #: >= 50% upload/compute overlap for the floor check to arm at all.
@@ -218,6 +220,20 @@ RECORDER_OVERHEAD_BAR = 0.03
 #: regression — fsync-per-entry, an unbounded tape scan, sealing under
 #: the router lock — reads well past 50%.
 RECORDER_COLLAPSE = 0.5
+
+#: Trend-plane overhead ratchet (ISSUE 20, the same collapse-floor
+#: pattern): the baseline must have demonstrated the rollup fold + the
+#: fingerprint sentinel costing <= 3% warm jobs/s (the tentpole's
+#: acceptance bar — both run once per poll tick off the already-parsed
+#: exposition, never on the placement path) for the check to arm...
+TRENDS_OVERHEAD_BAR = 0.03
+#: ...and once armed it fails only on a collapse ABOVE this (separate
+#: fleets per arm, so shared-runner load does not cancel — the
+#: recorder arm's observed noise applies verbatim); a genuine
+#: regression — the fold re-parsing the exposition per series, a
+#: persist under the router lock, an unbounded ring — reads well past
+#: 50%.
+TRENDS_COLLAPSE = 0.5
 
 
 def run_gate_bench() -> dict:
@@ -402,6 +418,48 @@ def compare(payload: dict, baseline: dict, ratio_tolerance: float,
                 f"{RECORDER_COLLAPSE:g}) — the always-on tape write is "
                 f"no longer in the noise on the placement path")
 
+    # Trend-plane contract (ISSUE 20): same shape as the recorder
+    # contract — the trends block must exist on every exit path
+    # (REQUIRED_KEYS), the dedicated section must have measured on a
+    # gate run (with the plane demonstrably live and ZERO regressions
+    # fired on a clean bench), and the trends-on vs ICT_TRENDS=0
+    # overhead fraction must not collapse whenever the baseline
+    # demonstrated the <= 3% bar.
+    tr = payload.get("trends")
+    if isinstance(tr, dict):
+        if tr.get("error"):
+            problems.append(
+                f"trends section errored: {tr['error']!r} — the "
+                "trend-plane arm did not measure")
+        elif tr.get("status") == "did_not_run":
+            problems.append(
+                "trends section did not run (BENCH_SKIP_TRENDS or an "
+                "early exit) — the gate requires the trend-plane arm")
+        elif not isinstance(tr.get("overhead_frac"), (int, float)):
+            problems.append("trends block has no overhead_frac")
+        elif not tr.get("trended_on"):
+            problems.append(
+                "trends.trended_on is false — the on-arm plane never "
+                "ticked or tracked a series, so nothing was measured")
+        elif tr.get("regressions_total", 0) > 0:
+            problems.append(
+                f"trends.regressions_total = {tr['regressions_total']} "
+                "on a clean bench — the sentinel fired with no injected "
+                "slowdown (a band/arming bug, or genuinely unstable "
+                "throughput)")
+        base_tr = baseline.get("trends")
+        if (isinstance(base_tr, dict)
+                and isinstance(base_tr.get("overhead_frac"), (int, float))
+                and base_tr["overhead_frac"] <= TRENDS_OVERHEAD_BAR
+                and isinstance(tr.get("overhead_frac"), (int, float))
+                and tr["overhead_frac"] > TRENDS_COLLAPSE):
+            problems.append(
+                f"trends.overhead_frac collapsed to "
+                f"{tr['overhead_frac']:.3g} (baseline "
+                f"{base_tr['overhead_frac']:.3g}, collapse threshold "
+                f"{TRENDS_COLLAPSE:g}) — the per-tick rollup fold + "
+                f"sentinel are no longer in the noise")
+
     # Cost-accounting contract (ISSUE 15): the costs block must exist on
     # every exit path (REQUIRED_KEYS) and, when the dedicated section
     # ran, must not have errored and must carry the attainment table —
@@ -524,6 +582,8 @@ def history_line(payload: dict, ok: bool) -> dict:
                              ).get("jobs_per_s_fleet"),
         "recorder_overhead_frac": (payload.get("recorder") or {}
                                    ).get("overhead_frac"),
+        "trends_overhead_frac": (payload.get("trends") or {}
+                                 ).get("overhead_frac"),
         "roofline_attainment": payload.get("roofline_attainment"),
         "ts": round(time.time(), 3),
         "ok": ok,
